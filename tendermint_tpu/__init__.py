@@ -1,0 +1,3 @@
+"""tendermint-tpu: TPU-native BFT state-machine-replication framework."""
+
+__version__ = "0.1.0"

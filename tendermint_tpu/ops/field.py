@@ -1,0 +1,199 @@
+"""Batched GF(2^255-19) arithmetic for TPU in radix-2^8 int32 limbs.
+
+TPU has no native 64-bit integer multiply, so field elements are held as 32
+little-endian limbs of 8 bits each in an int32 lane (shape `[..., 32]`).
+Schoolbook products of 8-bit limbs are <= 2^16 and a 32-term column sum plus
+the 19*2 fold stays below 2^29, comfortably inside int32 — every op is exact.
+All functions are shape-polymorphic over leading batch dims and jit/vmap
+friendly (static shapes, no data-dependent control flow).
+
+This is the substrate for the batch ed25519 verifier that replaces the
+reference's scalar per-vote verify (reference `types/vote_set.go:175`,
+`types/validator_set.go:247-249`).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+NLIMBS = 32
+RADIX = 8
+MASK = (1 << RADIX) - 1
+
+P = 2**255 - 19
+D = (-121665 * pow(121666, P - 2, P)) % P
+D2 = (2 * D) % P
+SQRT_M1 = pow(2, (P - 1) // 4, P)
+
+
+def int_to_limbs(x: int) -> np.ndarray:
+    """Python int (0 <= x < 2^256) -> np.int32[32] little-endian limbs."""
+    assert 0 <= x < 2**256
+    return np.array([(x >> (RADIX * i)) & MASK for i in range(NLIMBS)],
+                    dtype=np.int32)
+
+
+def limbs_to_int(limbs) -> int:
+    arr = np.asarray(limbs)
+    return sum(int(arr[..., i]) << (RADIX * i) for i in range(NLIMBS))
+
+
+def const(x: int) -> jnp.ndarray:
+    return jnp.asarray(int_to_limbs(x))
+
+
+# 8p in a 32-limb representation with small limbs (8p >= 2^256 so the
+# canonical byte representation does not exist; limbs [104, 255.., 1023]
+# sum to exactly 2^258 - 152).  Added before subtraction to keep limbs
+# nonnegative for any minuend with limbs < 2^9.
+_EIGHT_P = np.full(NLIMBS, 255, dtype=np.int32)
+_EIGHT_P[0] = 104
+_EIGHT_P[31] = 1023
+assert sum(int(v) << (8 * i) for i, v in enumerate(_EIGHT_P)) == 8 * P
+
+_P_LIMBS = int_to_limbs(P)
+
+
+def carry(x: jnp.ndarray) -> jnp.ndarray:
+    """Normalize limbs to [0, 2^9): two carry passes with 2^256 = 38 folds.
+
+    Accepts limbs in (-2^30, 2^30); arithmetic right shift gives floor
+    division so negative intermediate limbs are handled.
+    """
+    for _ in range(2):
+        outs = []
+        c = jnp.zeros_like(x[..., 0])
+        for i in range(NLIMBS):
+            v = x[..., i] + c
+            c = v >> RADIX
+            outs.append(v & MASK)
+        x = jnp.stack(outs, axis=-1)
+        x = x.at[..., 0].add(c * 38)
+    return x
+
+
+def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return carry(a + b)
+
+
+def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return carry(a - b + jnp.asarray(_EIGHT_P))
+
+
+def neg(a: jnp.ndarray) -> jnp.ndarray:
+    return carry(jnp.asarray(_EIGHT_P) - a)
+
+
+def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Schoolbook 32x32 limb product with fold of columns 32..62 by 38."""
+    shape = jnp.broadcast_shapes(a.shape, b.shape)
+    a = jnp.broadcast_to(a, shape)
+    b = jnp.broadcast_to(b, shape)
+    acc = jnp.zeros(shape[:-1] + (2 * NLIMBS - 1,), dtype=jnp.int32)
+    for i in range(NLIMBS):
+        acc = acc.at[..., i:i + NLIMBS].add(a[..., i:i + 1] * b)
+    lo = acc[..., :NLIMBS]
+    hi = acc[..., NLIMBS:]
+    lo = lo.at[..., :NLIMBS - 1].add(hi * 38)
+    return carry(lo)
+
+
+def sqr(a: jnp.ndarray) -> jnp.ndarray:
+    return mul(a, a)
+
+
+def mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Multiply by a small constant (k < 2^20)."""
+    return carry(a * k)
+
+
+def _nsqr(x: jnp.ndarray, n: int) -> jnp.ndarray:
+    for _ in range(n):
+        x = sqr(x)
+    return x
+
+
+def _pow_core(z: jnp.ndarray):
+    """Shared ladder: returns (z^(2^250-1), z^11, z^(2^50-1), z^(2^100-1))."""
+    z2 = sqr(z)
+    z9 = mul(_nsqr(z2, 2), z)
+    z11 = mul(z9, z2)
+    z_5_0 = mul(sqr(z11), z9)               # z^(2^5 - 1)
+    z_10_0 = mul(_nsqr(z_5_0, 5), z_5_0)    # z^(2^10 - 1)
+    z_20_0 = mul(_nsqr(z_10_0, 10), z_10_0)
+    z_40_0 = mul(_nsqr(z_20_0, 20), z_20_0)
+    z_50_0 = mul(_nsqr(z_40_0, 10), z_10_0)
+    z_100_0 = mul(_nsqr(z_50_0, 50), z_50_0)
+    z_200_0 = mul(_nsqr(z_100_0, 100), z_100_0)
+    z_250_0 = mul(_nsqr(z_200_0, 50), z_50_0)
+    return z_250_0, z11
+
+
+def inv(z: jnp.ndarray) -> jnp.ndarray:
+    """z^(p-2) = z^(2^255 - 21) via the ref10-style addition chain."""
+    z_250_0, z11 = _pow_core(z)
+    return mul(_nsqr(z_250_0, 5), z11)
+
+
+def pow22523(z: jnp.ndarray) -> jnp.ndarray:
+    """z^((p-5)/8) = z^(2^252 - 3)."""
+    z_250_0, _ = _pow_core(z)
+    return mul(_nsqr(z_250_0, 2), z)
+
+
+def canonical(x: jnp.ndarray) -> jnp.ndarray:
+    """Fully reduce to the canonical representative in [0, p), limbs [0,255]."""
+    x = carry(x)
+    # after carry limbs < 2^9 and limb0 may hold the +38 fold; one more
+    # fold-free pass brings every limb to [0,255] with zero carry-out ...
+    x = carry(x)
+    outs, c = [], jnp.zeros_like(x[..., 0])
+    for i in range(NLIMBS):
+        v = x[..., i] + c
+        c = v >> RADIX
+        outs.append(v & MASK)
+    x = jnp.stack(outs, axis=-1)
+    x = x.at[..., 0].add(c * 38)
+    outs, c = [], jnp.zeros_like(x[..., 0])
+    for i in range(NLIMBS):
+        v = x[..., i] + c
+        c = v >> RADIX
+        outs.append(v & MASK)
+    x = jnp.stack(outs, axis=-1)
+    # now x < 2^256: conditionally subtract p twice
+    p_l = jnp.asarray(_P_LIMBS)
+    for _ in range(2):
+        outs, borrow = [], jnp.zeros_like(x[..., 0])
+        for i in range(NLIMBS):
+            v = x[..., i] - p_l[i] - borrow
+            borrow = (v < 0).astype(jnp.int32)
+            outs.append(v + (borrow << RADIX))
+        diff = jnp.stack(outs, axis=-1)
+        ge = (borrow == 0)[..., None]
+        x = jnp.where(ge, diff, x)
+    return x
+
+
+def is_zero(x: jnp.ndarray) -> jnp.ndarray:
+    """Boolean [...,] mask: x == 0 mod p."""
+    return jnp.all(canonical(x) == 0, axis=-1)
+
+
+def eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return is_zero(sub(a, b))
+
+
+def parity(x: jnp.ndarray) -> jnp.ndarray:
+    """LSB of the canonical representative (the ed25519 sign bit source)."""
+    return canonical(x)[..., 0] & 1
+
+
+def to_bytes(x: jnp.ndarray) -> jnp.ndarray:
+    """Canonical little-endian 32-byte encoding, uint8[..., 32]."""
+    return canonical(x).astype(jnp.uint8)
+
+
+def from_bytes(b: jnp.ndarray) -> jnp.ndarray:
+    """uint8[..., 32] -> limbs (radix 2^8 means bytes are the limbs)."""
+    return b.astype(jnp.int32)

@@ -1,0 +1,162 @@
+"""Batched SHA-512 on TPU via paired-uint32 64-bit emulation.
+
+ed25519 needs SHA-512 for the verification challenge k = H(R || A || M)
+(reference era go-crypto; reference `types/vote_set.go:175` triggers one per
+vote).  TPU lanes are 32-bit, so each 64-bit word lives as a (hi, lo) uint32
+pair; rotations/shifts/adds are recomposed from 32-bit ops.  Message length
+is static per call site (sign-bytes are fixed-layout, see
+`tendermint_tpu.types.canonical`).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+_K64 = [
+    0x428a2f98d728ae22, 0x7137449123ef65cd, 0xb5c0fbcfec4d3b2f, 0xe9b5dba58189dbbc,
+    0x3956c25bf348b538, 0x59f111f1b605d019, 0x923f82a4af194f9b, 0xab1c5ed5da6d8118,
+    0xd807aa98a3030242, 0x12835b0145706fbe, 0x243185be4ee4b28c, 0x550c7dc3d5ffb4e2,
+    0x72be5d74f27b896f, 0x80deb1fe3b1696b1, 0x9bdc06a725c71235, 0xc19bf174cf692694,
+    0xe49b69c19ef14ad2, 0xefbe4786384f25e3, 0x0fc19dc68b8cd5b5, 0x240ca1cc77ac9c65,
+    0x2de92c6f592b0275, 0x4a7484aa6ea6e483, 0x5cb0a9dcbd41fbd4, 0x76f988da831153b5,
+    0x983e5152ee66dfab, 0xa831c66d2db43210, 0xb00327c898fb213f, 0xbf597fc7beef0ee4,
+    0xc6e00bf33da88fc2, 0xd5a79147930aa725, 0x06ca6351e003826f, 0x142929670a0e6e70,
+    0x27b70a8546d22ffc, 0x2e1b21385c26c926, 0x4d2c6dfc5ac42aed, 0x53380d139d95b3df,
+    0x650a73548baf63de, 0x766a0abb3c77b2a8, 0x81c2c92e47edaee6, 0x92722c851482353b,
+    0xa2bfe8a14cf10364, 0xa81a664bbc423001, 0xc24b8b70d0f89791, 0xc76c51a30654be30,
+    0xd192e819d6ef5218, 0xd69906245565a910, 0xf40e35855771202a, 0x106aa07032bbd1b8,
+    0x19a4c116b8d2d0c8, 0x1e376c085141ab53, 0x2748774cdf8eeb99, 0x34b0bcb5e19b48a8,
+    0x391c0cb3c5c95a63, 0x4ed8aa4ae3418acb, 0x5b9cca4f7763e373, 0x682e6ff3d6b2b8a3,
+    0x748f82ee5defb2fc, 0x78a5636f43172f60, 0x84c87814a1f0ab72, 0x8cc702081a6439ec,
+    0x90befffa23631e28, 0xa4506cebde82bde9, 0xbef9a3f7b2c67915, 0xc67178f2e372532b,
+    0xca273eceea26619c, 0xd186b8c721c0c207, 0xeada7dd6cde0eb1e, 0xf57d4f7fee6ed178,
+    0x06f067aa72176fba, 0x0a637dc5a2c898a6, 0x113f9804bef90dae, 0x1b710b35131c471b,
+    0x28db77f523047d84, 0x32caab7b40c72493, 0x3c9ebe0a15c9bebc, 0x431d67c49c100d4c,
+    0x4cc5d4becb3e42b6, 0x597f299cfc657e2a, 0x5fcb6fab3ad6faec, 0x6c44198c4a475817,
+]
+_KHI = np.array([k >> 32 for k in _K64], dtype=np.uint32)
+_KLO = np.array([k & 0xFFFFFFFF for k in _K64], dtype=np.uint32)
+
+_H0 = [0x6a09e667f3bcc908, 0xbb67ae8584caa73b, 0x3c6ef372fe94f82b,
+       0xa54ff53a5f1d36f1, 0x510e527fade682d1, 0x9b05688c2b3e6c1f,
+       0x1f83d9abfb41bd6b, 0x5be0cd19137e2179]
+
+
+def _add64(ah, al, bh, bl):
+    lo = al + bl
+    hi = ah + bh + (lo < al).astype(jnp.uint32)
+    return hi, lo
+
+
+def _rotr64(h, l, n):
+    if n == 0:
+        return h, l
+    if n < 32:
+        nh = (h >> np.uint32(n)) | (l << np.uint32(32 - n))
+        nl = (l >> np.uint32(n)) | (h << np.uint32(32 - n))
+        return nh, nl
+    if n == 32:
+        return l, h
+    return _rotr64(l, h, n - 32)
+
+
+def _shr64(h, l, n):
+    assert 0 < n < 32
+    return h >> np.uint32(n), (l >> np.uint32(n)) | (h << np.uint32(32 - n))
+
+
+def pad(nbytes: int) -> np.ndarray:
+    """Static SHA-512 padding suffix (uint8[...]): 0x80, zeros, 128-bit len."""
+    padlen = (112 - (nbytes + 1)) % 128
+    tail = np.zeros(1 + padlen + 16, dtype=np.uint8)
+    tail[0] = 0x80
+    bits = nbytes * 8
+    for i in range(16):
+        tail[-1 - i] = (bits >> (8 * i)) & 0xFF
+    return tail
+
+
+def _bytes_to_words(msg):
+    """uint8[..., 128*n] -> (hi, lo) uint32[..., n, 16] big-endian."""
+    n = msg.shape[-1] // 128
+    b = msg.reshape(msg.shape[:-1] + (n, 16, 8)).astype(jnp.uint32)
+    hi = (b[..., 0] << 24) | (b[..., 1] << 16) | (b[..., 2] << 8) | b[..., 3]
+    lo = (b[..., 4] << 24) | (b[..., 5] << 16) | (b[..., 6] << 8) | b[..., 7]
+    return hi, lo
+
+
+def _schedule(wh, wl):
+    zeros = jnp.zeros(wh.shape[:-1] + (64,), dtype=jnp.uint32)
+    wh = jnp.concatenate([wh, zeros], axis=-1)
+    wl = jnp.concatenate([wl, zeros], axis=-1)
+
+    def body(i, wv):
+        wh, wl = wv
+        a_h, a_l = jnp.take(wh, i - 15, axis=-1), jnp.take(wl, i - 15, axis=-1)
+        b_h, b_l = jnp.take(wh, i - 2, axis=-1), jnp.take(wl, i - 2, axis=-1)
+        s0 = _xor3(_rotr64(a_h, a_l, 1), _rotr64(a_h, a_l, 8), _shr64(a_h, a_l, 7))
+        s1 = _xor3(_rotr64(b_h, b_l, 19), _rotr64(b_h, b_l, 61), _shr64(b_h, b_l, 6))
+        h, l = _add64(jnp.take(wh, i - 16, axis=-1), jnp.take(wl, i - 16, axis=-1),
+                      *s0)
+        h, l = _add64(h, l, jnp.take(wh, i - 7, axis=-1), jnp.take(wl, i - 7, axis=-1))
+        h, l = _add64(h, l, *s1)
+        return wh.at[..., i].set(h), wl.at[..., i].set(l)
+
+    return lax.fori_loop(16, 80, body, (wh, wl))
+
+
+def _xor3(a, b, c):
+    return a[0] ^ b[0] ^ c[0], a[1] ^ b[1] ^ c[1]
+
+
+def _compress(state, wh16, wl16):
+    wh, wl = _schedule(wh16, wl16)
+    khi, klo = jnp.asarray(_KHI), jnp.asarray(_KLO)
+
+    def round_fn(i, st):
+        (ah, al, bh, bl, ch_, cl, dh, dl,
+         eh, el, fh, fl, gh, gl, hh, hl) = st
+        s1 = _xor3(_rotr64(eh, el, 14), _rotr64(eh, el, 18), _rotr64(eh, el, 41))
+        chh = (eh & fh) ^ (~eh & gh)
+        chl = (el & fl) ^ (~el & gl)
+        th, tl = _add64(hh, hl, *s1)
+        th, tl = _add64(th, tl, chh, chl)
+        th, tl = _add64(th, tl, khi[i], klo[i])
+        th, tl = _add64(th, tl, jnp.take(wh, i, axis=-1), jnp.take(wl, i, axis=-1))
+        s0 = _xor3(_rotr64(ah, al, 28), _rotr64(ah, al, 34), _rotr64(ah, al, 39))
+        majh = (ah & bh) ^ (ah & ch_) ^ (bh & ch_)
+        majl = (al & bl) ^ (al & cl) ^ (bl & cl)
+        t2h, t2l = _add64(*s0, majh, majl)
+        ndh, ndl = _add64(dh, dl, th, tl)
+        nah, nal = _add64(th, tl, t2h, t2l)
+        return (nah, nal, ah, al, bh, bl, ch_, cl,
+                ndh, ndl, eh, el, fh, fl, gh, gl)
+
+    st = lax.fori_loop(0, 80, round_fn, tuple(state))
+    out = []
+    for i in range(8):
+        h, l = _add64(state[2 * i], state[2 * i + 1], st[2 * i], st[2 * i + 1])
+        out.extend([h, l])
+    return tuple(out)
+
+
+def sha512(msg: jnp.ndarray) -> jnp.ndarray:
+    """uint8[..., N] (N static) -> digest uint8[..., 64]."""
+    n = msg.shape[-1]
+    tail = jnp.broadcast_to(jnp.asarray(pad(n)), msg.shape[:-1] + (len(pad(n)),))
+    padded = jnp.concatenate([msg, tail], axis=-1)
+    wh, wl = _bytes_to_words(padded)
+    state = []
+    for h in _H0:
+        state.append(jnp.broadcast_to(jnp.uint32(h >> 32), msg.shape[:-1]))
+        state.append(jnp.broadcast_to(jnp.uint32(h & 0xFFFFFFFF), msg.shape[:-1]))
+    state = tuple(state)
+    nblocks = wh.shape[-2]
+    for i in range(nblocks):
+        state = _compress(state, wh[..., i, :], wl[..., i, :])
+    # big-endian digest bytes
+    words = jnp.stack(state, axis=-1)  # [..., 16] hi/lo interleaved
+    parts = [(words >> np.uint32(s)).astype(jnp.uint8) for s in (24, 16, 8, 0)]
+    return jnp.stack(parts, axis=-1).reshape(msg.shape[:-1] + (64,))

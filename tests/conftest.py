@@ -1,0 +1,19 @@
+"""Test harness config: force JAX onto a virtual 8-device CPU mesh.
+
+Mirrors the reference's strategy of running multi-node nets in-process
+(reference `p2p/switch.go:495-543` MakeConnectedSwitches): we run multi-chip
+sharding tests on a virtual CPU mesh so the suite needs no TPU pod.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

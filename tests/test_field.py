@@ -1,0 +1,70 @@
+"""Differential tests: JAX limb field arithmetic vs Python big ints."""
+
+import random
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from tendermint_tpu.ops import field as fe
+
+P = fe.P
+rng = random.Random(1234)
+
+
+def _rand_batch(n):
+    xs = [rng.randrange(P) for _ in range(n)]
+    arr = np.stack([fe.int_to_limbs(x) for x in xs])
+    return xs, jnp.asarray(arr)
+
+
+def _check(vals, limbs):
+    got = [fe.limbs_to_int(np.asarray(fe.canonical(limbs))[i]) % P
+           for i in range(len(vals))]
+    assert got == [v % P for v in vals]
+
+
+def test_add_sub_mul_batch():
+    n = 64
+    xs, ax = _rand_batch(n)
+    ys, ay = _rand_batch(n)
+    _check([x + y for x, y in zip(xs, ys)], fe.add(ax, ay))
+    _check([x - y for x, y in zip(xs, ys)], fe.sub(ax, ay))
+    _check([x * y for x, y in zip(xs, ys)], fe.mul(ax, ay))
+    _check([-x for x in xs], fe.neg(ax))
+    _check([x * x for x in xs], fe.sqr(ax))
+
+
+def test_edge_values():
+    edge = [0, 1, 2, 19, P - 1, P - 2, P, P + 1, 2**255 - 1, 2**256 - 1 - 0,
+            2**255, 2**254 + 19]
+    edge = [e % 2**256 for e in edge]
+    arr = jnp.asarray(np.stack([fe.int_to_limbs(x) for x in edge]))
+    _check([x * x for x in edge], fe.mul(arr, arr))
+    _check([x + x for x in edge], fe.add(arr, arr))
+    _check([0 - x for x in edge], fe.sub(jnp.zeros_like(arr), arr))
+
+
+def test_inv_pow():
+    n = 16
+    xs, ax = _rand_batch(n)
+    _check([pow(x, P - 2, P) for x in xs], fe.inv(ax))
+    _check([pow(x, (P - 5) // 8, P) for x in xs], fe.pow22523(ax))
+
+
+def test_canonical_eq_parity():
+    xs, ax = _rand_batch(8)
+    assert bool(jnp.all(fe.eq(ax, ax)))
+    assert not bool(jnp.any(fe.eq(ax, fe.add(ax, fe.const(1)))))
+    par = np.asarray(fe.parity(ax))
+    assert list(par) == [x % 2 for x in xs]
+    # x and x + p are the same element
+    xp = jnp.asarray(np.stack([fe.int_to_limbs(x + P) for x in xs]))
+    assert bool(jnp.all(fe.eq(ax, xp)))
+
+
+def test_jit_vmap_composable():
+    f = jax.jit(lambda a, b: fe.mul(fe.add(a, b), fe.sub(a, b)))
+    xs, ax = _rand_batch(4)
+    ys, ay = _rand_batch(4)
+    _check([(x + y) * (x - y) for x, y in zip(xs, ys)], f(ax, ay))

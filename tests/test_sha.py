@@ -1,0 +1,43 @@
+"""Differential tests: batched device SHA-256/512 vs hashlib."""
+
+import hashlib
+import random
+
+import numpy as np
+import jax.numpy as jnp
+
+from tendermint_tpu.ops import sha256 as s256
+from tendermint_tpu.ops import sha512 as s512
+
+rng = random.Random(7)
+
+
+def _batch(n, length):
+    msgs = [bytes(rng.randrange(256) for _ in range(length)) for _ in range(n)]
+    arr = jnp.asarray(np.frombuffer(b"".join(msgs), dtype=np.uint8)
+                      .reshape(n, length)) if length else jnp.zeros((n, 0), jnp.uint8)
+    return msgs, arr
+
+
+def test_sha256_lengths():
+    for length in [0, 1, 32, 55, 56, 63, 64, 65, 127, 128, 200]:
+        msgs, arr = _batch(4, length)
+        got = np.asarray(s256.sha256(arr))
+        for i, m in enumerate(msgs):
+            assert got[i].tobytes() == hashlib.sha256(m).digest(), length
+
+
+def test_sha512_lengths():
+    for length in [0, 1, 32, 111, 112, 127, 128, 129, 192, 256]:
+        msgs, arr = _batch(4, length)
+        got = np.asarray(s512.sha512(arr))
+        for i, m in enumerate(msgs):
+            assert got[i].tobytes() == hashlib.sha512(m).digest(), length
+
+
+def test_sha256_batch_shape():
+    msgs, arr = _batch(8, 65)
+    got = np.asarray(s256.sha256(arr.reshape(2, 4, 65)))
+    assert got.shape == (2, 4, 32)
+    for i, m in enumerate(msgs):
+        assert got[i // 4, i % 4].tobytes() == hashlib.sha256(m).digest()
