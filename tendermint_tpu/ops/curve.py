@@ -1,0 +1,235 @@
+"""Batched edwards25519 group operations on TPU.
+
+Points live in extended homogeneous coordinates (X, Y, Z, T) with XY = ZT —
+each coordinate a radix-2^8 limb array `[..., 32]` from
+`tendermint_tpu.ops.field`.  All ops broadcast over leading batch dims and
+are built from static-shape primitives (lax.scan/fori_loop for ladders), so
+a single jit handles any batch size without graph blowup.
+
+This is the group layer under the batch ed25519 verifier that replaces the
+reference's scalar per-vote verify (reference `types/vote_set.go:175`,
+`types/validator_set.go:247-249`).  Formulas: add-2008-hwcd-3 /
+dbl-2008-hwcd for a=-1 twisted Edwards, the same shapes the reference-era
+Go ed25519 uses internally.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tendermint_tpu.ops import field as fe
+from tendermint_tpu.ops import scalar as sc
+from tendermint_tpu.crypto import pure_ed25519 as ref
+
+# Module-level constant limb arrays (device-cached by jit as needed).
+_D2 = fe.int_to_limbs(fe.D2)
+_SQRT_M1 = fe.int_to_limbs(fe.SQRT_M1)
+_D = fe.int_to_limbs(fe.D)
+_ONE = fe.int_to_limbs(1)
+_ZERO = np.zeros(fe.NLIMBS, dtype=np.int32)
+
+
+def identity(batch_shape=()) -> tuple:
+    z = jnp.broadcast_to(jnp.asarray(_ZERO), batch_shape + (fe.NLIMBS,))
+    o = jnp.broadcast_to(jnp.asarray(_ONE), batch_shape + (fe.NLIMBS,))
+    return (z, o, o, z)
+
+
+def pt_add(Q, R):
+    """Complete extended addition (add-2008-hwcd-3, a=-1): 9 field muls."""
+    x1, y1, z1, t1 = Q
+    x2, y2, z2, t2 = R
+    a = fe.mul(fe.sub(y1, x1), fe.sub(y2, x2))
+    b = fe.mul(fe.add(y1, x1), fe.add(y2, x2))
+    c = fe.mul(fe.mul(t1, t2), jnp.asarray(_D2))
+    d = fe.mul_small(fe.mul(z1, z2), 2)
+    e, f = fe.sub(b, a), fe.sub(d, c)
+    g, h = fe.add(d, c), fe.add(b, a)
+    return (fe.mul(e, f), fe.mul(g, h), fe.mul(f, g), fe.mul(e, h))
+
+
+def pt_add_affine(Q, aff):
+    """Mixed addition with a precomputed (y+x, y-x, 2d*x*y) entry: 7 muls.
+
+    The (1, 1, 0) entry acts as the identity, so window tables need no
+    special case for digit 0.
+    """
+    x1, y1, z1, t1 = Q
+    yplusx, yminusx, xy2d = aff
+    a = fe.mul(fe.sub(y1, x1), yminusx)
+    b = fe.mul(fe.add(y1, x1), yplusx)
+    c = fe.mul(t1, xy2d)
+    d = fe.mul_small(z1, 2)
+    e, f = fe.sub(b, a), fe.sub(d, c)
+    g, h = fe.add(d, c), fe.add(b, a)
+    return (fe.mul(e, f), fe.mul(g, h), fe.mul(f, g), fe.mul(e, h))
+
+
+def pt_dbl(Q):
+    """Dedicated doubling (dbl-2008-hwcd, a=-1): 4 sqr + 4 mul."""
+    x1, y1, z1, _ = Q
+    a = fe.sqr(x1)
+    b = fe.sqr(y1)
+    c = fe.mul_small(fe.sqr(z1), 2)
+    e = fe.sub(fe.sub(fe.sqr(fe.add(x1, y1)), a), b)   # 2*x*y
+    g = fe.sub(b, a)          # a*A + B with a=-1
+    f = fe.sub(g, c)
+    h = fe.neg(fe.add(a, b))  # a*A - B
+    return (fe.mul(e, f), fe.mul(g, h), fe.mul(f, g), fe.mul(e, h))
+
+
+def pt_neg(Q):
+    x, y, z, t = Q
+    return (fe.neg(x), y, z, fe.neg(t))
+
+
+def pt_eq(Q, R) -> jnp.ndarray:
+    """Projective equality mask: X1*Z2 == X2*Z1 and Y1*Z2 == Y2*Z1."""
+    x1, y1, z1, _ = Q
+    x2, y2, z2, _ = R
+    ex = fe.eq(fe.mul(x1, z2), fe.mul(x2, z1))
+    ey = fe.eq(fe.mul(y1, z2), fe.mul(y2, z1))
+    return ex & ey
+
+
+def pt_select(mask, Q, R):
+    """Elementwise select: mask[...] ? Q : R."""
+    m = mask[..., None]
+    return tuple(jnp.where(m, q, r) for q, r in zip(Q, R))
+
+
+def pt_on_curve(Q) -> jnp.ndarray:
+    """-x^2 + y^2 == z^2 + d*t^2  and  x*y == z*t (extended-coords check)."""
+    x, y, z, t = Q
+    lhs = fe.sub(fe.sqr(y), fe.sqr(x))
+    rhs = fe.add(fe.sqr(z), fe.mul(fe.sqr(t), jnp.asarray(_D)))
+    return fe.eq(lhs, rhs) & fe.eq(fe.mul(x, y), fe.mul(z, t))
+
+
+def _lt_p(b: jnp.ndarray) -> jnp.ndarray:
+    """Canonical-encoding check: little-endian bytes [..., 32] < p."""
+    return sc.lt_const(b, fe._P_LIMBS)
+
+
+def decompress(b: jnp.ndarray) -> tuple:
+    """uint8[..., 32] -> (point, ok_mask).
+
+    Matches `crypto.pure_ed25519.pt_decode` on every input: rejects y >= p,
+    non-residue x^2, and x == 0 with sign bit set.  On rejected lanes the
+    returned point is garbage and must be masked by `ok`.
+    """
+    sign = (b[..., 31] >> 7).astype(jnp.int32)
+    y_bytes = b.at[..., 31].set(b[..., 31] & 0x7F)
+    ok = _lt_p(y_bytes)
+    y = fe.from_bytes(y_bytes)
+    y2 = fe.sqr(y)
+    u = fe.sub(y2, jnp.asarray(_ONE))
+    v = fe.add(fe.mul(y2, jnp.asarray(_D)), jnp.asarray(_ONE))
+    v3 = fe.mul(fe.sqr(v), v)
+    v7 = fe.mul(fe.sqr(v3), v)
+    x = fe.mul(fe.mul(u, v3), fe.pow22523(fe.mul(u, v7)))
+    vx2 = fe.mul(v, fe.sqr(x))
+    root1 = fe.eq(vx2, u)
+    root2 = fe.eq(vx2, fe.neg(u))
+    x = jnp.where(root2[..., None], fe.mul(x, jnp.asarray(_SQRT_M1)), x)
+    ok = ok & (root1 | root2)
+    # x == 0 (i.e. u == 0) with sign bit set is invalid
+    ok = ok & ~(fe.is_zero(u) & (sign == 1))
+    flip = fe.parity(x) != sign
+    x = jnp.where(flip[..., None], fe.neg(x), x)
+    one = jnp.broadcast_to(jnp.asarray(_ONE), y.shape)
+    return (x, y, one, fe.mul(x, y)), ok
+
+
+def encode(Q) -> jnp.ndarray:
+    """Point -> canonical uint8[..., 32] (y with sign-of-x top bit)."""
+    x, y, z, _ = Q
+    zi = fe.inv(z)
+    xb = fe.parity(fe.mul(x, zi))
+    yb = fe.to_bytes(fe.mul(y, zi))
+    return yb.at[..., 31].set(yb[..., 31] | (xb << 7).astype(jnp.uint8))
+
+
+# --- scalar multiplication ------------------------------------------------
+
+def _build_window_table(Q):
+    """[..., 16, 32] per coordinate: T[j] = j*Q via 15 chained adds."""
+    def step(acc, _):
+        nxt = pt_add(acc, Q)
+        return nxt, acc
+    _, rows = lax.scan(step, identity(Q[0].shape[:-1]), None, length=16)
+    # rows: [16, ..., 32] per coord; move table axis next to limbs
+    return tuple(jnp.moveaxis(r, 0, -2) for r in rows)
+
+
+def scalar_mul(s: jnp.ndarray, Q) -> tuple:
+    """[s]Q for s = little-endian bytes/limbs [..., 32]; 4-bit windows.
+
+    256 doublings + 64 table adds + 15 setup adds, all under lax.scan so the
+    traced graph stays O(one window body).
+    """
+    tbl = _build_window_table(Q)
+    wins = sc.nibbles(s)                       # [..., 64] LSB-first
+    wins_t = jnp.moveaxis(wins, -1, 0)[::-1]   # [64, ...] MSB-first
+
+    def body(acc, w):
+        acc = lax.fori_loop(0, 4, lambda _, p: pt_dbl(p), acc)
+        sel = tuple(
+            jnp.take_along_axis(t, w[..., None, None], axis=-2)[..., 0, :]
+            for t in tbl)
+        return pt_add(acc, sel), None
+
+    acc, _ = lax.scan(body, identity(Q[0].shape[:-1]), wins_t)
+    return acc
+
+
+@functools.lru_cache(maxsize=None)
+def _base_table() -> np.ndarray:
+    """np.int32[32, 256, 3, 32]: window w, digit j -> affine precomp of
+    j * 2^(8w) * B as (y+x, y-x, 2d*x*y) limb rows.  Built once host-side
+    from the golden bigint reference."""
+    pts = []
+    P = ref.BASE
+    for w in range(32):
+        acc = ref.IDENT
+        for _ in range(256):
+            pts.append(acc)
+            acc = ref.pt_add(acc, P)
+        P = acc  # acc == 256 * P == 2^(8(w+1)) * B
+    # Montgomery batch inversion: one modexp for all 8192 Z coordinates.
+    prefix, run = [], 1
+    for p in pts:
+        prefix.append(run)
+        run = run * p[2] % ref.P
+    run_inv = pow(run, ref.P - 2, ref.P)
+    tbl = np.zeros((32, 256, 3, fe.NLIMBS), dtype=np.int32)
+    for idx in range(len(pts) - 1, -1, -1):
+        x, y, z, _ = pts[idx]
+        zi = run_inv * prefix[idx] % ref.P
+        run_inv = run_inv * z % ref.P
+        xa, ya = x * zi % ref.P, y * zi % ref.P
+        w, j = divmod(idx, 256)
+        tbl[w, j, 0] = fe.int_to_limbs((ya + xa) % ref.P)
+        tbl[w, j, 1] = fe.int_to_limbs((ya - xa) % ref.P)
+        tbl[w, j, 2] = fe.int_to_limbs(2 * fe.D * xa * ya % ref.P)
+    return tbl
+
+
+def scalar_mul_base(s: jnp.ndarray) -> tuple:
+    """[s]B via the fixed-base comb: 32 mixed adds, zero doublings."""
+    tbl = jnp.asarray(_base_table())           # [32, 256, 3, 32]
+    digits = jnp.moveaxis(s.astype(jnp.int32), -1, 0)  # [32, ...]
+
+    def body(acc, xs):
+        digit, tblw = xs
+        sel = jnp.take(tblw, digit, axis=0)    # [..., 3, 32]
+        aff = (sel[..., 0, :], sel[..., 1, :], sel[..., 2, :])
+        return pt_add_affine(acc, aff), None
+
+    acc, _ = lax.scan(body, identity(s.shape[:-1]), (digits, tbl))
+    return acc

@@ -1,0 +1,57 @@
+"""Batched ed25519 signature verification on TPU — the crypto hot plane.
+
+Replaces the reference's one-scalar-verify-per-vote
+(`types/vote_set.go:175`, `types/validator_set.go:247-264`): thousands of
+(message, pubkey, signature) triples are verified in one jitted call, with
+the SHA-512 challenge, the mod-L reduction, both scalar multiplications and
+the final point comparison all on device.
+
+Semantics are cofactorless verification — enc([s]B - [k]A) == R — matching
+`crypto.pure_ed25519.verify` (the golden reference) bit-for-bit on valid
+and adversarial inputs, plus the s < L malleability check.
+
+Messages in one batch must share a static byte length; the consensus
+sign-bytes layout is fixed-width for exactly this reason
+(`tendermint_tpu.types.canonical`).  Heterogeneous batches are handled by
+callers bucketing per length (see `crypto.backend`).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from tendermint_tpu.ops import curve
+from tendermint_tpu.ops import scalar as sc
+from tendermint_tpu.ops import sha512 as s512
+
+
+def verify_core(pubkeys: jnp.ndarray, sigs: jnp.ndarray,
+                k_scalars: jnp.ndarray) -> jnp.ndarray:
+    """Verification with a precomputed challenge scalar.
+
+    pubkeys uint8[..., 32], sigs uint8[..., 64], k int32/uint8[..., 32]
+    (k = H(R||A||M) mod L) -> bool[...].
+    """
+    A, ok_a = curve.decompress(pubkeys)
+    R, ok_r = curve.decompress(sigs[..., :32])
+    s_bytes = sigs[..., 32:]
+    ok_s = sc.lt_L(s_bytes)
+    sB = curve.scalar_mul_base(s_bytes)
+    kA = curve.scalar_mul(k_scalars, curve.pt_neg(A))
+    Rprime = curve.pt_add(sB, kA)
+    return ok_a & ok_r & ok_s & curve.pt_eq(Rprime, R)
+
+
+def verify(pubkeys: jnp.ndarray, msgs: jnp.ndarray,
+           sigs: jnp.ndarray) -> jnp.ndarray:
+    """Full batched verify: uint8 pubkeys[..., 32], msgs[..., M] (M static),
+    sigs[..., 64] -> bool[...]."""
+    challenge = jnp.concatenate(
+        [sigs[..., :32], pubkeys, msgs], axis=-1)
+    k = sc.reduce512(s512.sha512(challenge))
+    return verify_core(pubkeys, sigs, k)
+
+
+verify_batch = jax.jit(verify)
+"""jitted entry point; jax caches one executable per (batch, msg_len) shape."""

@@ -2,8 +2,16 @@
 
 TPU has no native 64-bit integer multiply, so field elements are held as 32
 little-endian limbs of 8 bits each in an int32 lane (shape `[..., 32]`).
-Schoolbook products of 8-bit limbs are <= 2^16 and a 32-term column sum plus
-the 19*2 fold stays below 2^29, comfortably inside int32 — every op is exact.
+
+Representation invariant ("normalized"): |limb| <= 512.  Carry propagation
+is done with *parallel* vector passes (shift the carry vector by one limb,
+fold the 2^256 overflow back with x38) instead of a 32-step sequential
+chain — interval analysis (executable: tests/test_field.py
+`test_carry_pass_counts_preserve_invariant`) shows 4 passes re-establish
+the invariant after a schoolbook product (columns <= 32*512^2*39 < 2^31,
+exact in int32) and 2 passes after add/sub.  This
+keeps both the XLA graph and the critical path shallow.
+
 All functions are shape-polymorphic over leading batch dims and jit/vmap
 friendly (static shapes, no data-dependent control flow).
 
@@ -14,6 +22,7 @@ reference's scalar per-vote verify (reference `types/vote_set.go:175`,
 
 from __future__ import annotations
 
+import jax
 import numpy as np
 import jax.numpy as jnp
 
@@ -45,8 +54,8 @@ def const(x: int) -> jnp.ndarray:
 
 # 8p in a 32-limb representation with small limbs (8p >= 2^256 so the
 # canonical byte representation does not exist; limbs [104, 255.., 1023]
-# sum to exactly 2^258 - 152).  Added before subtraction to keep limbs
-# nonnegative for any minuend with limbs < 2^9.
+# sum to exactly 2^258 - 152).  Added before subtraction so the value stays
+# nonnegative for any normalized subtrahend.
 _EIGHT_P = np.full(NLIMBS, 255, dtype=np.int32)
 _EIGHT_P[0] = 104
 _EIGHT_P[31] = 1023
@@ -55,11 +64,28 @@ assert sum(int(v) << (8 * i) for i, v in enumerate(_EIGHT_P)) == 8 * P
 _P_LIMBS = int_to_limbs(P)
 
 
-def carry(x: jnp.ndarray) -> jnp.ndarray:
-    """Normalize limbs to [0, 2^9): two carry passes with 2^256 = 38 folds.
+def carry(x: jnp.ndarray, passes: int = 4) -> jnp.ndarray:
+    """Parallel carry: `passes` rounds of  x -> (x & 255) + shift(x >> 8),
+    with the limb-31 carry folded into limb 0 via 2^256 = 38 (mod p).
 
-    Accepts limbs in (-2^30, 2^30); arithmetic right shift gives floor
-    division so negative intermediate limbs are handled.
+    Exact for |limb| < 2^31 / 39; arithmetic right shift gives floor
+    division so negative limbs are handled.  Re-establishes |limb| <= 512
+    given enough passes for the input bound (4 covers a schoolbook product,
+    2 covers one add/sub of normalized values).
+    """
+    for _ in range(passes):
+        c = x >> RADIX
+        x = x & MASK
+        x = x.at[..., 1:].add(c[..., :-1])
+        x = x.at[..., 0].add(c[..., -1] * 38)
+    return x
+
+
+def carry_exact(x: jnp.ndarray) -> jnp.ndarray:
+    """Sequential exact carry: limbs -> [0,255] with full fold; value < 2^256.
+
+    Only used by `canonical` (rare path); hot paths use the parallel carry.
+    Requires value >= 0 (all library ops preserve nonnegative values).
     """
     for _ in range(2):
         outs = []
@@ -74,29 +100,36 @@ def carry(x: jnp.ndarray) -> jnp.ndarray:
 
 
 def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    return carry(a + b)
+    return carry(a + b, passes=2)
 
 
 def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    return carry(a - b + jnp.asarray(_EIGHT_P))
+    return carry(a - b + jnp.asarray(_EIGHT_P), passes=2)
 
 
 def neg(a: jnp.ndarray) -> jnp.ndarray:
-    return carry(jnp.asarray(_EIGHT_P) - a)
+    return carry(jnp.asarray(_EIGHT_P) - a, passes=2)
 
 
 def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """Schoolbook 32x32 limb product with fold of columns 32..62 by 38."""
+    """Schoolbook 32x32 limb product with fold of columns 32..62 by 38.
+
+    Columns are accumulated as a stack of shifted partial products (shallow,
+    XLA-fusable) rather than a sequential update chain.
+    """
     shape = jnp.broadcast_shapes(a.shape, b.shape)
     a = jnp.broadcast_to(a, shape)
     b = jnp.broadcast_to(b, shape)
-    acc = jnp.zeros(shape[:-1] + (2 * NLIMBS - 1,), dtype=jnp.int32)
-    for i in range(NLIMBS):
-        acc = acc.at[..., i:i + NLIMBS].add(a[..., i:i + 1] * b)
+    pads = [(0, 0)] * (len(shape) - 1)
+    rows = [
+        jnp.pad(a[..., i:i + 1] * b, pads + [(i, NLIMBS - 1 - i)])
+        for i in range(NLIMBS)
+    ]
+    acc = jnp.sum(jnp.stack(rows, axis=0), axis=0)
     lo = acc[..., :NLIMBS]
     hi = acc[..., NLIMBS:]
     lo = lo.at[..., :NLIMBS - 1].add(hi * 38)
-    return carry(lo)
+    return carry(lo, passes=4)
 
 
 def sqr(a: jnp.ndarray) -> jnp.ndarray:
@@ -104,18 +137,23 @@ def sqr(a: jnp.ndarray) -> jnp.ndarray:
 
 
 def mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:
-    """Multiply by a small constant (k < 2^20)."""
-    return carry(a * k)
+    """Multiply by a small constant (normalized a, k <= 4)."""
+    assert 1 <= k <= 4
+    return carry(a * k, passes=2)
 
 
 def _nsqr(x: jnp.ndarray, n: int) -> jnp.ndarray:
-    for _ in range(n):
-        x = sqr(x)
-    return x
+    # fori_loop keeps the inversion ladder's XLA graph at one sqr per chain
+    # link instead of unrolling ~254 of them.
+    if n < 4:
+        for _ in range(n):
+            x = sqr(x)
+        return x
+    return jax.lax.fori_loop(0, n, lambda _, v: sqr(v), x)
 
 
 def _pow_core(z: jnp.ndarray):
-    """Shared ladder: returns (z^(2^250-1), z^11, z^(2^50-1), z^(2^100-1))."""
+    """Shared ladder: returns (z^(2^250-1), z^11)."""
     z2 = sqr(z)
     z9 = mul(_nsqr(z2, 2), z)
     z11 = mul(z9, z2)
@@ -144,24 +182,8 @@ def pow22523(z: jnp.ndarray) -> jnp.ndarray:
 
 def canonical(x: jnp.ndarray) -> jnp.ndarray:
     """Fully reduce to the canonical representative in [0, p), limbs [0,255]."""
-    x = carry(x)
-    # after carry limbs < 2^9 and limb0 may hold the +38 fold; one more
-    # fold-free pass brings every limb to [0,255] with zero carry-out ...
-    x = carry(x)
-    outs, c = [], jnp.zeros_like(x[..., 0])
-    for i in range(NLIMBS):
-        v = x[..., i] + c
-        c = v >> RADIX
-        outs.append(v & MASK)
-    x = jnp.stack(outs, axis=-1)
-    x = x.at[..., 0].add(c * 38)
-    outs, c = [], jnp.zeros_like(x[..., 0])
-    for i in range(NLIMBS):
-        v = x[..., i] + c
-        c = v >> RADIX
-        outs.append(v & MASK)
-    x = jnp.stack(outs, axis=-1)
-    # now x < 2^256: conditionally subtract p twice
+    x = carry_exact(carry(x, passes=4))
+    # value now < 2^256 < 2p + 39: conditionally subtract p twice
     p_l = jnp.asarray(_P_LIMBS)
     for _ in range(2):
         outs, borrow = [], jnp.zeros_like(x[..., 0])

@@ -68,3 +68,51 @@ def test_jit_vmap_composable():
     xs, ax = _rand_batch(4)
     ys, ay = _rand_batch(4)
     _check([(x + y) * (x - y) for x, y in zip(xs, ys)], f(ax, ay))
+
+
+NORM = 512  # the |limb| <= 512 normalization invariant from field.py
+
+
+def _carry_bounds(limb0: float, rest: float, passes: int):
+    """Interval analysis of fe.carry: worst-case |limb| magnitudes.
+
+    One pass: limb i>=1 <= 255 + max|limb|/256 (carry from the left
+    neighbour); limb 0 <= 255 + 38 * |limb31|/256 (the 2^256 fold).
+    """
+    for _ in range(passes):
+        c_general = max(limb0, rest) / 256
+        c31 = rest / 256
+        limb0, rest = 255 + 38 * c31, 255 + c_general
+    return limb0, rest
+
+
+def test_carry_pass_counts_preserve_invariant():
+    # mul: columns <= 32 * NORM^2, after the x38 fold <= 39x that — must be
+    # exact in int32 and return to the invariant in the 4 passes mul uses.
+    mul_start = 39 * 32 * NORM * NORM
+    assert mul_start < 2**31
+    assert max(_carry_bounds(mul_start, mul_start, 4)) <= NORM
+    # add/sub: |a| + |b| + eight_p limbs (<= 1023), 2 passes.
+    addsub_start = 2 * NORM + 1023
+    assert max(_carry_bounds(addsub_start, addsub_start, 2)) <= NORM
+    # mul_small(k<=4): 2 passes from 4*NORM.
+    assert max(_carry_bounds(4 * NORM, 4 * NORM, 2)) <= NORM
+
+
+def test_carry_adversarial_limbs():
+    # limbs at the invariant extremes, mixed signs — exactness check vs bigint
+    cases = []
+    for pattern in [
+        np.full(fe.NLIMBS, NORM, dtype=np.int32),
+        np.full(fe.NLIMBS, -NORM, dtype=np.int32),
+        np.array([NORM if i % 2 else -NORM for i in range(fe.NLIMBS)],
+                 dtype=np.int32),
+    ]:
+        cases.append(pattern)
+    arr = jnp.asarray(np.stack(cases))
+    vals = [sum(int(c[i]) << (8 * i) for i in range(fe.NLIMBS)) for c in cases]
+    # values may be negative; compare mod p after a mul (mul requires the
+    # invariant, which these extremes satisfy)
+    got = [fe.limbs_to_int(np.asarray(fe.canonical(fe.mul(arr, arr)))[i])
+           for i in range(len(vals))]
+    assert got == [v * v % P for v in vals]
