@@ -1,0 +1,129 @@
+"""Pluggable crypto backends: the seam between consensus and the TPU.
+
+The reference verifies one signature at a time behind `PubKey.VerifyBytes`
+(reference `types/vote_set.go:175`, `types/validator_set.go:247-249`).
+This framework routes every bulk verification through a `Backend` so the
+caller (VoteSet tally, ValidatorSet.VerifyCommit, fast-sync, light client)
+never knows whether signatures are checked by the bigint reference, a
+native CPU library, or a TPU batch kernel — the `--crypto-backend` flag
+from BASELINE.md picks the implementation.
+
+Batches are padded to power-of-two buckets so the TPU backend compiles a
+handful of shapes once and reuses them for any workload size.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Protocol
+
+import numpy as np
+
+from tendermint_tpu.crypto import pure_ed25519 as _ref
+
+MIN_BUCKET = 16
+
+
+class Backend(Protocol):
+    name: str
+
+    def verify_batch(self, pubkeys: np.ndarray, msgs: np.ndarray,
+                     sigs: np.ndarray) -> np.ndarray:
+        """uint8 [N,32] pubkeys, [N,M] msgs (equal-length), [N,64] sigs
+        -> bool[N]."""
+        ...
+
+
+def _bucket(n: int) -> int:
+    b = MIN_BUCKET
+    while b < n:
+        b *= 2
+    return b
+
+
+class PythonBackend:
+    """Golden bigint implementation — slow, obviously correct."""
+    name = "python"
+
+    def verify_batch(self, pubkeys, msgs, sigs):
+        out = np.zeros(len(pubkeys), dtype=bool)
+        for i in range(len(pubkeys)):
+            out[i] = _ref.verify(pubkeys[i].tobytes(), msgs[i].tobytes(),
+                                 sigs[i].tobytes())
+        return out
+
+
+class TpuBackend:
+    """JAX batch kernel (`tendermint_tpu.ops.ed25519`) with shape bucketing.
+
+    Also runs on the CPU XLA backend — "tpu" names the code path, not the
+    physical device; jax picks whatever platform is configured.
+    """
+    name = "tpu"
+
+    def __init__(self):
+        # import lazily so the python backend works without jax configured
+        import jax.numpy as jnp
+        from tendermint_tpu.ops import ed25519 as dev
+        self._jnp = jnp
+        self._dev = dev
+
+    def verify_batch(self, pubkeys, msgs, sigs):
+        n = len(pubkeys)
+        if n == 0:
+            return np.zeros(0, dtype=bool)
+        b = _bucket(n)
+        pad = b - n
+        if pad:
+            pubkeys = np.concatenate([pubkeys, np.repeat(pubkeys[:1], pad, 0)])
+            msgs = np.concatenate([msgs, np.repeat(msgs[:1], pad, 0)])
+            sigs = np.concatenate([sigs, np.repeat(sigs[:1], pad, 0)])
+        jnp = self._jnp
+        out = self._dev.verify_batch(jnp.asarray(pubkeys), jnp.asarray(msgs),
+                                     jnp.asarray(sigs))
+        return np.asarray(out)[:n]
+
+
+_BACKENDS = {
+    "python": PythonBackend,
+    "tpu": TpuBackend,
+}
+
+_lock = threading.Lock()
+_current: Backend | None = None
+
+
+def register(name: str, factory) -> None:
+    _BACKENDS[name] = factory
+
+
+def set_backend(name: str) -> Backend:
+    global _current
+    with _lock:
+        _current = _BACKENDS[name]()
+    return _current
+
+
+def get_backend() -> Backend:
+    global _current
+    with _lock:
+        if _current is None:
+            name = os.environ.get("TM_CRYPTO_BACKEND", "tpu")
+            if name not in _BACKENDS:
+                raise ValueError(
+                    f"unknown TM_CRYPTO_BACKEND={name!r}; "
+                    f"known: {sorted(_BACKENDS)}")
+            try:
+                _current = _BACKENDS[name]()
+            except ImportError as e:
+                import warnings
+                warnings.warn(
+                    f"crypto backend {name!r} unavailable ({e}); "
+                    f"falling back to the slow python backend")
+                _current = PythonBackend()
+    return _current
+
+
+def verify_batch(pubkeys, msgs, sigs) -> np.ndarray:
+    return get_backend().verify_batch(pubkeys, msgs, sigs)

@@ -1,0 +1,130 @@
+"""Batched SHA-256 Merkle tree hashing on TPU.
+
+Computes the same roots as the host tree (`tendermint_tpu.types.merkle` —
+recursive (n+1)//2 split, 0x00/0x01 domain separation; shape from reference
+`types/tx.go:29-43`) for a whole batch of equal-shaped trees at once: leaf
+hashing is one lockstep SHA-256 over [B, n, leaf_len] and each tree level
+is one lockstep SHA-256 over gathered (left, right) pairs.
+
+The level schedule depends only on n (static under jit); trees in a batch
+share it.  Used for block data hashes and part-set roots in batched
+fast-sync replay (bench configs 2-3) where the reference re-hashes
+per-block on the CPU (`blockchain/reactor.go:224`).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from tendermint_tpu.ops import sha256 as s256
+
+LEAF_PREFIX = 0x00
+INNER_PREFIX = 0x01
+
+
+class _Node:
+    __slots__ = ("left", "right", "parent", "height")
+
+    def __init__(self, left=None, right=None):
+        self.left, self.right = left, right
+        self.parent = None
+        self.height = 0 if left is None else 1 + max(left.height,
+                                                     right.height)
+        for c in (left, right):
+            if c is not None:
+                c.parent = self
+
+
+@functools.lru_cache(maxsize=None)
+def _plan(n: int) -> tuple:
+    """Level schedule for an n-leaf reference-shaped tree.
+
+    Returns a tuple of steps; step s is (pairs, singles): pairs int32[m, 2]
+    indexes the previous level's array for (left, right) children of every
+    height-s node, singles int32[k] indexes nodes passing through because
+    their parent combines at a later step.  The next level's array is the
+    pair outputs followed by the singles, in DFS order each.
+    """
+    if n == 0:
+        return ()
+
+    def build(lo: int, hi: int) -> _Node:
+        if hi - lo == 1:
+            return _Node()
+        k = (hi - lo + 1) // 2
+        return _Node(build(lo, lo + k), build(lo + k, hi))
+
+    root = build(0, n)
+    # DFS order for deterministic intra-level ordering
+    order: dict[_Node, int] = {}
+
+    def dfs(node: _Node):
+        order[node] = len(order)
+        if node.left is not None:
+            dfs(node.left)
+            dfs(node.right)
+
+    dfs(root)
+
+    by_height: dict[int, list[_Node]] = {}
+    for node in order:
+        by_height.setdefault(node.height, []).append(node)
+    for nodes in by_height.values():
+        nodes.sort(key=order.__getitem__)
+
+    # level 0: leaves in DFS order == leaf index order
+    current = by_height[0]
+    slot = {node: i for i, node in enumerate(current)}
+    steps = []
+    for s in range(1, root.height + 1):
+        combined = by_height.get(s, [])
+        pairs = np.asarray([[slot[nd.left], slot[nd.right]]
+                            for nd in combined], dtype=np.int32).reshape(-1, 2)
+        singles_nodes = [nd for nd in current
+                         if nd.parent is not None and nd.parent.height != s]
+        singles = np.asarray([slot[nd] for nd in singles_nodes],
+                             dtype=np.int32)
+        current = combined + singles_nodes
+        slot = {node: i for i, node in enumerate(current)}
+        steps.append((pairs, singles))
+    assert len(current) == 1
+    return tuple(steps)
+
+
+def leaf_hashes(data: jnp.ndarray) -> jnp.ndarray:
+    """uint8[..., n, L] -> leaf hashes uint8[..., n, 32] (0x00-prefixed)."""
+    prefix = jnp.full(data.shape[:-1] + (1,), LEAF_PREFIX, dtype=jnp.uint8)
+    return s256.sha256(jnp.concatenate([prefix, data], axis=-1))
+
+
+def root_from_leaf_hashes(h: jnp.ndarray) -> jnp.ndarray:
+    """uint8[..., n, 32] leaf hashes -> root uint8[..., 32]."""
+    n = h.shape[-2]
+    if n == 0:
+        raise ValueError("empty tree has a constant root; hash host-side")
+    for pairs, singles in _plan(n):
+        left = jnp.take(h, jnp.asarray(pairs[:, 0]), axis=-2)
+        right = jnp.take(h, jnp.asarray(pairs[:, 1]), axis=-2)
+        prefix = jnp.full(left.shape[:-1] + (1,), INNER_PREFIX,
+                          dtype=jnp.uint8)
+        combined = s256.sha256(
+            jnp.concatenate([prefix, left, right], axis=-1))
+        if len(singles):
+            passthrough = jnp.take(h, jnp.asarray(singles), axis=-2)
+            h = jnp.concatenate([combined, passthrough], axis=-2)
+        else:
+            h = combined
+    return h[..., 0, :]
+
+
+def roots(data: jnp.ndarray) -> jnp.ndarray:
+    """uint8[..., n, L] equal-length leaves -> roots uint8[..., 32]."""
+    return root_from_leaf_hashes(leaf_hashes(data))
+
+
+roots_jit = jax.jit(roots)
+root_from_leaf_hashes_jit = jax.jit(root_from_leaf_hashes)

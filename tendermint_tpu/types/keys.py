@@ -1,0 +1,68 @@
+"""ed25519 key types and addresses.
+
+Mirrors the reference's go-crypto surface (`PrivKeyEd25519`,
+`PubKey.VerifyBytes`, address = hash of pubkey — reference
+`types/priv_validator.go:96-100`, go-crypto).  Addresses here are
+sha256(pubkey)[:20] (the reference era used RIPEMD-160; this framework
+standardizes on SHA-256 throughout, see SURVEY.md §2.2).
+
+Scalar sign/verify run host-side via the golden bigint implementation —
+they are cold paths (one signature per consensus step).  Batch verification
+goes through `tendermint_tpu.crypto.backend`.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import secrets
+from dataclasses import dataclass
+
+from tendermint_tpu.crypto import pure_ed25519 as _ed
+
+ADDRESS_LEN = 20
+
+
+def address_from_pubkey(pub: bytes) -> bytes:
+    return hashlib.sha256(pub).digest()[:ADDRESS_LEN]
+
+
+@dataclass(frozen=True)
+class PubKey:
+    """32-byte ed25519 public key; the gate consensus verifies through
+    (reference `types/vote_set.go:175` PubKey.VerifyBytes)."""
+    bytes_: bytes
+
+    def __post_init__(self):
+        if len(self.bytes_) != 32:
+            raise ValueError("pubkey must be 32 bytes")
+
+    @property
+    def address(self) -> bytes:
+        return address_from_pubkey(self.bytes_)
+
+    def verify(self, msg: bytes, sig: bytes) -> bool:
+        return _ed.verify(self.bytes_, msg, sig)
+
+    def hex(self) -> str:
+        return self.bytes_.hex()
+
+
+@dataclass(frozen=True)
+class PrivKey:
+    """32-byte seed; signing is deterministic RFC-8032."""
+    seed: bytes
+
+    def __post_init__(self):
+        if len(self.seed) != 32:
+            raise ValueError("seed must be 32 bytes")
+
+    @classmethod
+    def generate(cls) -> "PrivKey":
+        return cls(secrets.token_bytes(32))
+
+    @property
+    def pub_key(self) -> PubKey:
+        return PubKey(_ed.pubkey_from_seed(self.seed))
+
+    def sign(self, msg: bytes) -> bytes:
+        return _ed.sign(self.seed, msg)

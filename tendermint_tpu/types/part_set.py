@@ -1,0 +1,131 @@
+"""PartSet: blocks chunked into Merkle-proved parts for gossip.
+
+Reference: `types/part_set.go` — serialized block split into 64KB parts
+(`types/block.go:18-19,115-117`), each part hashed into a simple Merkle
+tree with per-part inclusion proofs verified on receive
+(`types/part_set.go:95-122,188-214`).  Different peers serve different
+parts concurrently; the proof lets a receiver validate each part against
+the proposal's PartSetHeader before assembly.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from tendermint_tpu.types import merkle
+from tendermint_tpu.types.codec import Reader, lp_bytes, u32
+
+PART_SIZE = 64 * 1024  # reference types/block.go:19
+
+
+@dataclass(frozen=True)
+class PartSetHeader:
+    total: int
+    hash: bytes
+
+    def is_zero(self) -> bool:
+        return self.total == 0 and not self.hash
+
+    def encode(self) -> bytes:
+        return u32(self.total) + lp_bytes(self.hash)
+
+    @classmethod
+    def decode(cls, r: Reader) -> "PartSetHeader":
+        return cls(total=r.u32(), hash=r.lp_bytes())
+
+    def __str__(self):
+        return f"{self.total}:{self.hash.hex()[:12]}"
+
+
+ZERO_PSH = PartSetHeader(0, b"")
+
+
+@dataclass(frozen=True)
+class Part:
+    index: int
+    bytes_: bytes
+    proof: merkle.Proof
+
+    def verify(self, header: PartSetHeader) -> bool:
+        if self.index != self.proof.index or self.proof.total != header.total:
+            return False
+        if merkle.leaf_hash(self.bytes_) != self.proof.leaf:
+            return False
+        return self.proof.verify(header.hash)
+
+    def encode(self) -> bytes:
+        out = u32(self.index) + lp_bytes(self.bytes_)
+        out += u32(self.proof.total) + u32(self.proof.index)
+        out += lp_bytes(self.proof.leaf) + u32(len(self.proof.aunts))
+        for a in self.proof.aunts:
+            out += lp_bytes(a)
+        return out
+
+    @classmethod
+    def decode(cls, r: Reader) -> "Part":
+        index = r.u32()
+        data = r.lp_bytes()
+        total, pidx = r.u32(), r.u32()
+        leaf = r.lp_bytes()
+        aunts = tuple(r.lp_bytes() for _ in range(r.u32()))
+        return cls(index, data, merkle.Proof(total, pidx, leaf, aunts))
+
+
+class PartSet:
+    """A complete or in-progress set of parts for one block."""
+
+    def __init__(self, header: PartSetHeader):
+        self.header = header
+        self._parts: list[Part | None] = [None] * header.total
+        self._count = 0
+
+    @classmethod
+    def from_data(cls, data: bytes, part_size: int = PART_SIZE) -> "PartSet":
+        """Chunk serialized block bytes into proved parts
+        (reference `types/part_set.go:95-122`)."""
+        chunks = [data[i:i + part_size] for i in range(0, len(data), part_size)]
+        if not chunks:
+            chunks = [b""]
+        rt, proofs = merkle.proofs(chunks)
+        ps = cls(PartSetHeader(len(chunks), rt))
+        for i, (c, pr) in enumerate(zip(chunks, proofs)):
+            ps._parts[i] = Part(i, c, pr)
+        ps._count = len(chunks)
+        return ps
+
+    def add_part(self, part: Part) -> bool:
+        """Verify against the header and store; False on invalid/duplicate
+        index mismatch (reference `types/part_set.go:188-214`)."""
+        if not (0 <= part.index < self.header.total):
+            return False
+        if self._parts[part.index] is not None:
+            return False
+        if not part.verify(self.header):
+            return False
+        self._parts[part.index] = part
+        self._count += 1
+        return True
+
+    def get_part(self, index: int) -> Part | None:
+        return self._parts[index]
+
+    def has_part(self, index: int) -> bool:
+        return self._parts[index] is not None
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def total(self) -> int:
+        return self.header.total
+
+    def is_complete(self) -> bool:
+        return self._count == self.header.total
+
+    def bit_array(self) -> list[bool]:
+        return [p is not None for p in self._parts]
+
+    def assemble(self) -> bytes:
+        assert self.is_complete()
+        return b"".join(p.bytes_ for p in self._parts)

@@ -1,0 +1,54 @@
+"""Merkle tree shape, proofs, and map hashing."""
+
+import hashlib
+
+from tendermint_tpu.types import merkle
+
+
+def test_empty_and_single():
+    assert merkle.root([]) == hashlib.sha256(b"").digest()
+    one = merkle.root([b"x"])
+    assert one == merkle.leaf_hash(b"x")
+
+
+def test_reference_tree_shape():
+    # 5 leaves: split (n+1)//2 = 3 | 2 (reference types/tx.go:33)
+    items = [bytes([i]) * 4 for i in range(5)]
+    h = [merkle.leaf_hash(i) for i in items]
+    left = merkle.inner_hash(merkle.inner_hash(h[0], h[1]), h[2])
+    right = merkle.inner_hash(h[3], h[4])
+    assert merkle.root(items) == merkle.inner_hash(left, right)
+
+
+def test_proofs_roundtrip():
+    for n in [1, 2, 3, 4, 5, 7, 8, 13, 64]:
+        items = [b"item%d" % i for i in range(n)]
+        rt, proofs = merkle.proofs(items)
+        assert rt == merkle.root(items)
+        for i, p in enumerate(proofs):
+            assert p.index == i and p.total == n
+            assert p.verify(rt), (n, i)
+            # tampered root fails
+            assert not p.verify(b"\x00" * 32)
+
+
+def test_proof_rejects_wrong_leaf():
+    items = [b"a", b"b", b"c"]
+    rt, proofs = merkle.proofs(items)
+    bad = merkle.Proof(proofs[0].total, proofs[0].index,
+                       merkle.leaf_hash(b"evil"), proofs[0].aunts)
+    assert not bad.verify(rt)
+
+
+def test_domain_separation():
+    # leaf(x) != inner for colliding concatenations
+    a, b = merkle.leaf_hash(b"ab"), merkle.leaf_hash(b"a")
+    assert merkle.root([b"ab"]) != merkle.root([b"a", b"b"])
+    assert a != merkle.inner_hash(b, merkle.leaf_hash(b"b"))
+
+
+def test_root_of_map_deterministic():
+    m1 = {"b": b"2", "a": b"1", "c": b"3"}
+    m2 = {"a": b"1", "c": b"3", "b": b"2"}
+    assert merkle.root_of_map(m1) == merkle.root_of_map(m2)
+    assert merkle.root_of_map(m1) != merkle.root_of_map({**m1, "a": b"x"})
