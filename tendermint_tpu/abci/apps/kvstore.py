@@ -1,0 +1,104 @@
+"""kvstore ("dummy") app: the reference's default test application.
+
+Reference: abci example dummy app (used via `--proxy_app=dummy`,
+`proxy/client.go:65-73`): txs are `key=value` (or `value` meaning
+`value=value`); state is a map; app hash commits to the contents.
+Persistent variant stores to disk and survives restarts, reporting its
+last height in Info for handshake replay.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+from tendermint_tpu.abci.app import Application, register_app
+from tendermint_tpu.abci.types import (OK, ResponseInfo,
+                                       ResponseQuery, Result)
+
+
+class KVStoreApp(Application):
+    def __init__(self):
+        self.state: dict[bytes, bytes] = {}
+        self.height = 0
+
+    def _app_hash(self) -> bytes:
+        h = hashlib.sha256()
+        for k in sorted(self.state):
+            h.update(len(k).to_bytes(4, "big") + k)
+            h.update(len(self.state[k]).to_bytes(4, "big") + self.state[k])
+        h.update(self.height.to_bytes(8, "big"))
+        return h.digest()[:20]
+
+    def info(self) -> ResponseInfo:
+        return ResponseInfo(data=f"{{\"size\":{len(self.state)}}}",
+                            last_block_height=self.height,
+                            last_block_app_hash=(self._app_hash()
+                                                 if self.height else b""))
+
+    def check_tx(self, tx: bytes) -> Result:
+        return Result(OK)
+
+    def deliver_tx(self, tx: bytes) -> Result:
+        if b"=" in tx:
+            k, v = tx.split(b"=", 1)
+        else:
+            k = v = tx
+        self.state[k] = v
+        return Result(OK)
+
+    def end_block(self, height: int):
+        from tendermint_tpu.abci.types import ResponseEndBlock
+        return ResponseEndBlock()
+
+    def commit(self) -> Result:
+        self.height += 1
+        return Result(OK, data=self._app_hash())
+
+    def query(self, data: bytes, path: str = "/", height: int = 0,
+              prove: bool = False) -> ResponseQuery:
+        v = self.state.get(data)
+        if v is None:
+            return ResponseQuery(code=OK, key=data, log="does not exist",
+                                 height=self.height)
+        return ResponseQuery(code=OK, key=data, value=v, log="exists",
+                             height=self.height)
+
+
+class PersistentKVStoreApp(KVStoreApp):
+    """Disk-backed variant (reference `persistent_dummy`): used by crash
+    tests — Info() reports the persisted height for handshake replay."""
+
+    def __init__(self, db_path: str | None = None):
+        super().__init__()
+        self.db_path = db_path or os.environ.get(
+            "TM_KVSTORE_PATH", "kvstore_app.json")
+        self._load()
+
+    def _load(self):
+        if os.path.exists(self.db_path):
+            with open(self.db_path) as f:
+                d = json.load(f)
+            self.height = d["height"]
+            self.state = {bytes.fromhex(k): bytes.fromhex(v)
+                          for k, v in d["state"].items()}
+
+    def commit(self) -> Result:
+        res = super().commit()
+        tmp = self.db_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"height": self.height,
+                       "state": {k.hex(): v.hex()
+                                 for k, v in self.state.items()}}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.db_path)
+        return res
+
+
+register_app("kvstore", KVStoreApp)
+register_app("dummy", KVStoreApp)
+register_app("persistent_kvstore", PersistentKVStoreApp)
+register_app("persistent_dummy", PersistentKVStoreApp)
+register_app("nilapp", Application)

@@ -1,0 +1,99 @@
+"""Persistent block store keyed by height.
+
+Reference: `blockchain/store.go` — BlockMeta, parts stored individually,
+Commit + SeenCommit per height (`LoadBlock` `:60-81`, `SaveBlock` `:147`);
+blocks reassemble from their parts on load.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from tendermint_tpu.types import Block, BlockID, Commit, PartSet
+from tendermint_tpu.types.codec import Reader, u32, u64
+from tendermint_tpu.types.part_set import Part
+
+
+@dataclass
+class BlockMeta:
+    block_id: BlockID
+    height: int
+    num_txs: int
+
+    def encode(self) -> bytes:
+        return self.block_id.encode() + u64(self.height) + u32(self.num_txs)
+
+    @classmethod
+    def decode_bytes(cls, data: bytes) -> "BlockMeta":
+        r = Reader(data)
+        out = cls(block_id=BlockID.decode(r), height=r.u64(), num_txs=r.u32())
+        r.expect_done()
+        return out
+
+
+class BlockStore:
+    def __init__(self, db):
+        self.db = db
+        raw = db.get(b"blockStore:height")
+        self._height = int.from_bytes(raw, "big") if raw else 0
+
+    @property
+    def height(self) -> int:
+        """Height of the highest stored block."""
+        return self._height
+
+    # -- save -----------------------------------------------------------
+    def save_block(self, block: Block, part_set: PartSet,
+                   seen_commit: Commit) -> None:
+        """Persist block meta + parts + commits (reference
+        `blockchain/store.go:147-186`); SeenCommit carries the +2/3 for
+        THIS block (needed to propose next height after restart)."""
+        h = block.height
+        if h != self._height + 1:
+            raise ValueError(f"save_block height {h}, expected "
+                             f"{self._height + 1}")
+        if not part_set.is_complete():
+            raise ValueError("cannot save incomplete part set")
+        meta = BlockMeta(block_id=BlockID(block.hash(), part_set.header),
+                         height=h, num_txs=len(block.txs))
+        kvs = [(b"H:%d" % h, meta.encode())]
+        for i in range(part_set.total):
+            kvs.append((b"P:%d:%d" % (h, i), part_set.get_part(i).encode()))
+        kvs.append((b"C:%d" % h, block.last_commit.encode()))
+        kvs.append((b"SC:%d" % h, seen_commit.encode()))
+        kvs.append((b"blockStore:height", h.to_bytes(8, "big")))
+        self.db.set_batch(kvs)
+        self._height = h
+
+    # -- load -----------------------------------------------------------
+    def load_block_meta(self, height: int) -> BlockMeta | None:
+        raw = self.db.get(b"H:%d" % height)
+        return BlockMeta.decode_bytes(raw) if raw else None
+
+    def load_part(self, height: int, index: int) -> Part | None:
+        raw = self.db.get(b"P:%d:%d" % (height, index))
+        return Part.decode(Reader(raw)) if raw else None
+
+    def load_block(self, height: int) -> Block | None:
+        """Reassemble from parts (reference `blockchain/store.go:60-81`)."""
+        meta = self.load_block_meta(height)
+        if meta is None:
+            return None
+        chunks = []
+        for i in range(meta.block_id.parts.total):
+            part = self.load_part(height, i)
+            if part is None:
+                raise ValueError(
+                    f"block store corrupt: height {height} missing part {i}")
+            chunks.append(part.bytes_)
+        return Block.decode_bytes(b"".join(chunks))
+
+    def load_block_commit(self, height: int) -> Commit | None:
+        """The commit for block `height` stored in block height+1
+        (reference `blockchain/store.go:113`)."""
+        raw = self.db.get(b"C:%d" % (height + 1))
+        return Commit.decode(Reader(raw)) if raw else None
+
+    def load_seen_commit(self, height: int) -> Commit | None:
+        raw = self.db.get(b"SC:%d" % height)
+        return Commit.decode(Reader(raw)) if raw else None

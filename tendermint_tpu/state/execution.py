@@ -1,0 +1,133 @@
+"""Block validation and execution against the ABCI app.
+
+Reference: `state/execution.go` — `ApplyBlock` (`:210`) = validate ->
+exec txs on the consensus conn -> index txs -> save ABCIResponses ->
+update validator set from EndBlock diffs (`:117-156`) ->
+`CommitStateUpdateMempool` with the mempool locked across the app Commit
+(`:248-271`) -> save state; `validateBlock` verifies LastCommit with
+LastValidators.VerifyCommit (`:177-202`) — here one batched device call;
+`ExecCommitBlock` for fast replay (`:291-308`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from tendermint_tpu.abci.types import RequestBeginBlock
+from tendermint_tpu.state.state import ABCIResponses, State
+from tendermint_tpu.types import BlockID
+from tendermint_tpu.types.events import EventCache, event_tx
+from tendermint_tpu.utils.fail import fail_point
+
+
+class MockMempool:
+    """No-op mempool for replay paths (reference `types/services.go:31-42`)."""
+
+    def lock(self):
+        pass
+
+    def unlock(self):
+        pass
+
+    def update(self, height: int, txs: list[bytes]):
+        pass
+
+
+@dataclass
+class TxEvent:
+    """Payload of a per-tx event (fired during exec, flushed post-commit)."""
+    height: int
+    tx: bytes
+    result: object
+    index: int
+
+
+def validate_block(state: State, block) -> None:
+    """Full contextual validation (reference `state/execution.go:173-202`)."""
+    block.validate_basic()
+    h = block.header
+    if h.chain_id != state.chain_id:
+        raise ValueError(f"wrong chain id {h.chain_id!r}")
+    if h.height != state.last_block_height + 1:
+        raise ValueError(f"wrong height {h.height}, "
+                         f"expected {state.last_block_height + 1}")
+    if h.last_block_id.key() != state.last_block_id.key():
+        raise ValueError("wrong last_block_id")
+    if h.app_hash != state.app_hash:
+        raise ValueError(f"wrong app_hash {h.app_hash.hex()} "
+                         f"!= {state.app_hash.hex()}")
+    if h.validators_hash != state.validators.hash():
+        raise ValueError("wrong validators_hash")
+    if h.height > 1:
+        # THE hot verification: +2/3 of last_validators signed last block
+        if len(block.last_commit.precommits) != state.last_validators.size():
+            raise ValueError("last_commit size != last validator set")
+        state.last_validators.verify_commit(
+            state.chain_id, h.last_block_id, h.height - 1, block.last_commit)
+
+
+def exec_block_on_app(proxy_consensus, block, event_cache: EventCache | None):
+    """BeginBlock / DeliverTx xN / EndBlock (reference
+    `state/execution.go:43-115`); returns ABCIResponses."""
+    proxy_consensus.begin_block(
+        RequestBeginBlock(hash=block.hash(), header=block.header))
+    results = []
+    for i, tx in enumerate(block.txs):
+        res = proxy_consensus.deliver_tx(tx)
+        results.append(res)
+        if event_cache is not None:
+            from tendermint_tpu.types.tx import Tx
+            event_cache.fire(event_tx(Tx(tx).hash),
+                             TxEvent(block.height, tx, res, i))
+    end = proxy_consensus.end_block(block.height)
+    diffs = [(v.pub_key, v.power) for v in end.diffs]
+    return ABCIResponses(height=block.height, deliver_txs=results,
+                         end_block_diffs=diffs)
+
+
+def apply_block(state: State, event_cache, proxy_consensus, block,
+                part_set_header, mempool, tx_indexer=None) -> State:
+    """Validate, execute, commit one block; returns the advanced state
+    (reference `state/execution.go:210-245`).  Mutates `state` in place
+    and persists it; callers pass a copy if they need the old one."""
+    validate_block(state, block)
+    fail_point("ApplyBlock.validated")
+    resp = exec_block_on_app(proxy_consensus, block, event_cache)
+    fail_point("ApplyBlock.executed")
+    if tx_indexer is not None:
+        tx_indexer.index_block(block, resp)
+    state.save_abci_responses(resp)
+    fail_point("ApplyBlock.savedResponses")
+    block_id = BlockID(hash=block.hash(), parts=part_set_header)
+    state.set_block_and_validators(block.header, block_id,
+                                   resp.end_block_diffs)
+    # commit the app + update mempool under its lock
+    commit_state_update_mempool(state, proxy_consensus, block, mempool)
+    fail_point("ApplyBlock.committed")
+    state.save()
+    return state
+
+
+def commit_state_update_mempool(state: State, proxy_consensus, block,
+                                mempool) -> None:
+    """App Commit with the mempool locked so no CheckTx runs against a
+    half-committed app (reference `state/execution.go:248-271`)."""
+    mempool.lock()
+    try:
+        res = proxy_consensus.commit()
+        if not res.is_ok:
+            raise RuntimeError(f"app Commit failed: {res.log}")
+        state.app_hash = res.data
+        mempool.update(block.height, block.txs)
+    finally:
+        mempool.unlock()
+
+
+def exec_commit_block(proxy_consensus, block) -> bytes:
+    """Execute + commit without state mutation — handshake replay of
+    app-missing blocks (reference `state/execution.go:291-308`)."""
+    exec_block_on_app(proxy_consensus, block, None)
+    res = proxy_consensus.commit()
+    if not res.is_ok:
+        raise RuntimeError(f"app Commit failed: {res.log}")
+    return res.data

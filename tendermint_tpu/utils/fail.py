@@ -1,0 +1,46 @@
+"""Crash-point injection for persistence tests.
+
+Reference: ebuchman/fail-test (`glide.yaml:5`) — `fail.Fail()` call sites
+abort the process when FAIL_TEST_INDEX selects them
+(`consensus/state.go:1285-1346`, `state/execution.go:218-237`;
+exercised by `test/persist/test_failure_indices.sh`).
+
+Here fail points are *named* and counted: TM_FAIL_INDEX=i kills the
+process at the i-th hit; TM_FAIL_POINT=name kills at a named site.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+_lock = threading.Lock()
+_counter = 0
+_callback = None
+
+
+def set_callback(cb) -> None:
+    """Testing hook: called instead of os._exit (in-process crash sim)."""
+    global _callback
+    _callback = cb
+
+
+def fail_point(name: str) -> None:
+    global _counter
+    target_idx = os.environ.get("TM_FAIL_INDEX")
+    target_name = os.environ.get("TM_FAIL_POINT")
+    if target_idx is None and target_name is None:
+        return
+    with _lock:
+        idx = _counter
+        _counter += 1
+    hit = ((target_idx is not None and idx == int(target_idx)) or
+           (target_name is not None and name == target_name))
+    if hit:
+        if _callback is not None:
+            _callback(name, idx)
+            return
+        import sys
+        print(f"FAIL_POINT hit: {name} (index {idx})", file=sys.stderr,
+              flush=True)
+        os._exit(66)
