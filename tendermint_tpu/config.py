@@ -1,0 +1,123 @@
+"""Nested configuration with defaults and fast test variants.
+
+Reference: `config/config.go` — Config{Base, RPC, P2P, Mempool, Consensus}
+(`:12-21`), defaults (`:57-132`), consensus timeouts (`:364-381`), test
+variants with memdb + 10ms timeouts (`:34-42,384-396`).  TOML scaffolding
+in `tendermint_tpu.cli` (reference `config/toml.go`).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+
+@dataclass
+class BaseConfig:
+    chain_id: str = ""
+    home: str = "~/.tendermint_tpu"
+    proxy_app: str = "kvstore"           # registry name or tcp:// addr
+    moniker: str = "anonymous"
+    fast_sync: bool = True
+    db_backend: str = "sqlite"           # sqlite | memdb
+    log_level: str = "info"
+    crypto_backend: str = "tpu"          # tpu | python | native
+
+    def root(self) -> str:
+        return os.path.expanduser(self.home)
+
+    def genesis_file(self) -> str:
+        return os.path.join(self.root(), "genesis.json")
+
+    def priv_validator_file(self) -> str:
+        return os.path.join(self.root(), "priv_validator.json")
+
+    def db_dir(self) -> str:
+        return os.path.join(self.root(), "data")
+
+
+@dataclass
+class RPCConfig:
+    laddr: str = "tcp://0.0.0.0:26657"
+    grpc_laddr: str = ""
+    unsafe: bool = False
+
+
+@dataclass
+class P2PConfig:
+    laddr: str = "tcp://0.0.0.0:26656"
+    seeds: list[str] = field(default_factory=list)
+    persistent_peers: list[str] = field(default_factory=list)
+    max_num_peers: int = 50
+    pex: bool = True
+    send_rate: int = 512_000             # B/s (reference p2p/connection.go:31)
+    recv_rate: int = 512_000
+    flush_throttle_ms: int = 100
+    handshake_timeout_s: float = 20.0
+    dial_timeout_s: float = 3.0
+    fuzz: bool = False
+
+
+@dataclass
+class MempoolConfig:
+    recheck: bool = True
+    broadcast: bool = True
+    wal_dir: str = ""
+    cache_size: int = 100_000            # reference mempool/mempool.go:51
+
+
+@dataclass
+class ConsensusConfig:
+    wal_dir: str = ""
+    wal_light: bool = False
+    # reference config/config.go:364-381 (ms)
+    timeout_propose: float = 3.0
+    timeout_propose_delta: float = 0.5
+    timeout_prevote: float = 1.0
+    timeout_prevote_delta: float = 0.5
+    timeout_precommit: float = 1.0
+    timeout_precommit_delta: float = 0.5
+    timeout_commit: float = 1.0
+    skip_timeout_commit: bool = False
+    max_block_size_txs: int = 10_000
+    create_empty_blocks: bool = True
+    create_empty_blocks_interval: float = 0.0
+
+    def propose_timeout(self, round_: int) -> float:
+        return self.timeout_propose + self.timeout_propose_delta * round_
+
+    def prevote_timeout(self, round_: int) -> float:
+        return self.timeout_prevote + self.timeout_prevote_delta * round_
+
+    def precommit_timeout(self, round_: int) -> float:
+        return self.timeout_precommit + self.timeout_precommit_delta * round_
+
+
+@dataclass
+class Config:
+    base: BaseConfig = field(default_factory=BaseConfig)
+    rpc: RPCConfig = field(default_factory=RPCConfig)
+    p2p: P2PConfig = field(default_factory=P2PConfig)
+    mempool: MempoolConfig = field(default_factory=MempoolConfig)
+    consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
+
+
+def default_config() -> Config:
+    return Config()
+
+
+def test_config() -> Config:
+    """Fast in-memory config (reference `config/config.go:384-396`)."""
+    c = Config()
+    c.base.db_backend = "memdb"
+    c.base.crypto_backend = "python"
+    c.base.fast_sync = False
+    c.consensus.timeout_propose = 0.1
+    c.consensus.timeout_propose_delta = 0.002
+    c.consensus.timeout_prevote = 0.02
+    c.consensus.timeout_prevote_delta = 0.002
+    c.consensus.timeout_precommit = 0.02
+    c.consensus.timeout_precommit_delta = 0.002
+    c.consensus.timeout_commit = 0.02
+    c.consensus.skip_timeout_commit = True
+    return c

@@ -1,0 +1,740 @@
+"""The Tendermint BFT round state machine.
+
+Reference: `consensus/state.go` (1620 LoC) — steps NewHeight -> Propose ->
+Prevote -> PrevoteWait -> Precommit -> PrecommitWait -> Commit (`:47-57`);
+a single serialized receive loop consumes peer messages, own messages, and
+timeouts (`receiveRoutine` `:617-661`) so every state transition is
+deterministic and WAL-replayable; POL lock/unlock rules (`:1497-1526`);
+proposal creation (`createProposalBlock` `:961-981`); finalize + ApplyBlock
+(`finalizeCommit` `:1259-1356`).
+
+Fidelity notes: transitions carry the reference's names and ordering; the
+WAL records every input before it is handled; own messages loop back
+through the same queue as peer messages.  The crypto behind vote ingestion
+and commit verification is the pluggable batch backend.
+"""
+
+from __future__ import annotations
+
+import queue
+import struct
+import threading
+import time
+from dataclasses import dataclass
+
+from tendermint_tpu import config as config_mod
+from tendermint_tpu.consensus import messages as M
+from tendermint_tpu.consensus.height_vote_set import HeightVoteSet
+from tendermint_tpu.consensus.ticker import TimeoutInfo, TimeoutTicker
+from tendermint_tpu.consensus.wal import WAL, REC_ENDHEIGHT, REC_MESSAGE, REC_TIMEOUT
+from tendermint_tpu.state import execution
+from tendermint_tpu.state.state import State
+from tendermint_tpu.types import (Block, BlockID, Commit, EMPTY_COMMIT,
+                                  PartSet, Proposal, TYPE_PRECOMMIT,
+                                  TYPE_PREVOTE, Vote, VoteSet, ZERO_BLOCK_ID)
+from tendermint_tpu.types import events as ev
+from tendermint_tpu.types.events import EventCache, EventSwitch
+from tendermint_tpu.types.priv_validator import DoubleSignError
+from tendermint_tpu.types.vote import ErrVoteConflict
+from tendermint_tpu.utils.fail import fail_point
+
+# round steps (reference consensus/state.go:47-57)
+STEP_NEW_HEIGHT = 1
+STEP_NEW_ROUND = 2
+STEP_PROPOSE = 3
+STEP_PREVOTE = 4
+STEP_PREVOTE_WAIT = 5
+STEP_PRECOMMIT = 6
+STEP_PRECOMMIT_WAIT = 7
+STEP_COMMIT = 8
+
+STEP_NAMES = {
+    STEP_NEW_HEIGHT: "NewHeight", STEP_NEW_ROUND: "NewRound",
+    STEP_PROPOSE: "Propose", STEP_PREVOTE: "Prevote",
+    STEP_PREVOTE_WAIT: "PrevoteWait", STEP_PRECOMMIT: "Precommit",
+    STEP_PRECOMMIT_WAIT: "PrecommitWait", STEP_COMMIT: "Commit",
+}
+
+
+@dataclass
+class RoundStepEvent:
+    height: int
+    round: int
+    step: int
+    seconds_since_start: int
+    last_commit_round: int
+
+
+class ConsensusState:
+    """Single-node consensus core.  The reactor (gossip) layer plugs in via
+    `broadcast_cb` (outbound messages) and the public feed methods
+    (inbound); RPC reads via `get_round_state_summary`."""
+
+    def __init__(self, cfg: config_mod.ConsensusConfig, state: State,
+                 proxy_consensus, block_store, mempool,
+                 priv_validator=None, evsw: EventSwitch | None = None,
+                 wal_path: str = "", ticker=None, tx_indexer=None):
+        self.cfg = cfg
+        self.proxy = proxy_consensus
+        self.block_store = block_store
+        self.mempool = mempool
+        self.priv_validator = priv_validator
+        self.evsw = evsw or EventSwitch()
+        self.tx_indexer = tx_indexer
+        self.broadcast_cb = None          # reactor hook: fn(msg)
+
+        self._queue: queue.Queue = queue.Queue(maxsize=10_000)
+        self._ticker = ticker or TimeoutTicker(self._on_timeout_fire)
+        self._thread: threading.Thread | None = None
+        self._stopped = threading.Event()
+        self._mtx = threading.RLock()
+
+        self.wal = WAL(wal_path, light=cfg.wal_light) if wal_path else None
+        self._replay_mode = False
+
+        # --- RoundState (reference :89-106) ---
+        self.height = 0
+        self.round = 0
+        self.step = STEP_NEW_HEIGHT
+        self.start_time = 0.0
+        self.commit_time = 0.0
+        self.state: State | None = None
+        self.validators = None
+        self.proposal: Proposal | None = None
+        self.proposal_block: Block | None = None
+        self.proposal_block_parts: PartSet | None = None
+        self.locked_round = -1
+        self.locked_block: Block | None = None
+        self.locked_block_parts: PartSet | None = None
+        self.votes: HeightVoteSet | None = None
+        self.commit_round = -1
+        self.last_commit: VoteSet | None = None
+
+        self._update_to_state(state)
+        self._reconstruct_last_commit(state)
+
+    def _reconstruct_last_commit(self, state: State) -> None:
+        """Rebuild last_commit from the stored SeenCommit after a restart
+        (reference `reconstructLastCommit`, consensus/state.go:368-393)."""
+        if state.last_block_height == 0 or self.last_commit is not None:
+            return
+        seen = self.block_store.load_seen_commit(state.last_block_height)
+        if seen is None:
+            raise RuntimeError(
+                f"no seen commit for height {state.last_block_height}")
+        vset = VoteSet(state.chain_id, state.last_block_height, seen.round(),
+                       TYPE_PRECOMMIT, state.last_validators)
+        outcomes = vset.add_votes_batched(
+            [v for v in seen.precommits if v is not None])
+        if not vset.has_two_thirds_majority():
+            raise RuntimeError(
+                f"seen commit does not have +2/3: {outcomes}")
+        self.last_commit = vset
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    def start(self) -> None:
+        if self.wal is not None:
+            self._catchup_replay()
+        self._thread = threading.Thread(target=self._receive_routine,
+                                        daemon=True, name="consensus")
+        self._thread.start()
+        self._schedule_round_0()
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self._ticker.stop()
+        self._queue.put(None)
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        if self.wal is not None:
+            self.wal.close()
+
+    def wait_until_stopped(self, timeout=None):
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    # ------------------------------------------------------------------
+    # public inbound API (thread-safe; reference :425-470)
+    # ------------------------------------------------------------------
+    def add_vote(self, vote: Vote, peer_id: str = "") -> None:
+        self._queue.put((M.VoteMessage(vote), peer_id))
+
+    def set_proposal(self, proposal: Proposal, peer_id: str = "") -> None:
+        self._queue.put((M.ProposalMessage(proposal), peer_id))
+
+    def add_proposal_block_part(self, height: int, round_: int, part,
+                                peer_id: str = "") -> None:
+        self._queue.put((M.BlockPartMessage(height, round_, part), peer_id))
+
+    def set_peer_maj23(self, height, round_, type_, peer_id, block_id):
+        if height == self.height and self.votes is not None:
+            self.votes.set_peer_maj23(round_, type_, peer_id, block_id)
+
+    def get_round_state_summary(self) -> dict:
+        with self._mtx:
+            return {
+                "height": self.height, "round": self.round,
+                "step": STEP_NAMES.get(self.step, self.step),
+                "proposal": (str(self.proposal)
+                             if self.proposal else None),
+                "locked_round": self.locked_round,
+                "locked_block": (self.locked_block.hash().hex()
+                                 if self.locked_block else None),
+                "start_time": self.start_time,
+            }
+
+    def is_proposer(self) -> bool:
+        return (self.priv_validator is not None and
+                self.validators.proposer.address ==
+                self.priv_validator.address)
+
+    # ------------------------------------------------------------------
+    # the serialized receive loop (reference :617-661)
+    # ------------------------------------------------------------------
+    def _receive_routine(self) -> None:
+        while not self._stopped.is_set():
+            item = self._queue.get()
+            if item is None:
+                return
+            try:
+                with self._mtx:
+                    if isinstance(item, TimeoutInfo):
+                        if self.wal is not None and not self._replay_mode:
+                            self.wal.save_timeout(item.height, item.round,
+                                                  item.step)
+                        self._handle_timeout(item)
+                    else:
+                        msg, peer_id = item
+                        if self.wal is not None and not self._replay_mode:
+                            if not (self.wal.light and
+                                    isinstance(msg, M.BlockPartMessage) and
+                                    peer_id):
+                                self.wal.save_message(M.encode_msg(msg))
+                        self._handle_msg(msg, peer_id)
+            except Exception:
+                import traceback
+                traceback.print_exc()
+
+    def _on_timeout_fire(self, ti: TimeoutInfo) -> None:
+        self._queue.put(ti)
+
+    def _handle_msg(self, msg, peer_id: str) -> None:
+        if isinstance(msg, M.ProposalMessage):
+            self._set_proposal(msg.proposal)
+        elif isinstance(msg, M.BlockPartMessage):
+            self._add_proposal_block_part(msg.height, msg.part)
+        elif isinstance(msg, M.VoteMessage):
+            try:
+                self._try_add_vote(msg.vote, peer_id)
+            except ErrVoteConflict as e:
+                # equivocation: evidence captured; byzantine peer
+                self.evsw.fire("EvidenceDoubleSign", e.evidence)
+        else:
+            pass  # reactor-level messages are not for the core
+
+    def _handle_timeout(self, ti: TimeoutInfo) -> None:
+        """Reference `:664-701` handleTimeout."""
+        if (ti.height, ti.round, ti.step) < (self.height, self.round,
+                                             self.step):
+            return
+        if ti.step == STEP_NEW_HEIGHT:
+            self._enter_new_round(ti.height, 0)
+        elif ti.step == STEP_PROPOSE:
+            self.evsw.fire(ev.TIMEOUT_PROPOSE, self._round_step_event())
+            self._enter_prevote(ti.height, ti.round)
+        elif ti.step == STEP_PREVOTE_WAIT:
+            self.evsw.fire(ev.TIMEOUT_WAIT, self._round_step_event())
+            self._enter_precommit(ti.height, ti.round)
+        elif ti.step == STEP_PRECOMMIT_WAIT:
+            self.evsw.fire(ev.TIMEOUT_WAIT, self._round_step_event())
+            self._enter_new_round(ti.height, ti.round + 1)
+
+    # ------------------------------------------------------------------
+    # state update & round scheduling
+    # ------------------------------------------------------------------
+    def _update_to_state(self, state: State) -> None:
+        """Prepare for the next height (reference `updateToState` :535-597)."""
+        if (self.commit_round > -1 and 0 < self.height and
+                self.height != state.last_block_height):
+            raise RuntimeError("updateToState expected state at height "
+                               f"{self.height}")
+        # last precommits carry into the next proposal's commit
+        last_precommits = None
+        if self.commit_round > -1 and self.votes is not None:
+            pc = self.votes.precommits(self.commit_round)
+            if pc is None or not pc.has_two_thirds_majority():
+                raise RuntimeError("expected +2/3 precommits for last commit")
+            last_precommits = pc
+
+        height = state.last_block_height + 1
+        self.height = height
+        self.round = 0
+        self.step = STEP_NEW_HEIGHT
+        if self.commit_time:
+            self.start_time = self.commit_time + self.cfg.timeout_commit
+        else:
+            self.start_time = time.time() + self.cfg.timeout_commit
+        self.validators = state.validators.copy()
+        self.proposal = None
+        self.proposal_block = None
+        self.proposal_block_parts = None
+        self.locked_round = -1
+        self.locked_block = None
+        self.locked_block_parts = None
+        self.votes = HeightVoteSet(state.chain_id, height, self.validators)
+        self.commit_round = -1
+        self.last_commit = last_precommits
+        self.state = state
+
+    def _schedule_round_0(self) -> None:
+        sleep = max(0.0, self.start_time - time.time())
+        self._ticker.schedule_timeout(TimeoutInfo(self.height, 0,
+                                                  STEP_NEW_HEIGHT, sleep))
+
+    def _new_step(self, step: int) -> None:
+        self.step = step
+        rs = self._round_step_event()
+        self.evsw.fire(ev.NEW_ROUND_STEP, rs)
+        self._broadcast(M.NewRoundStepMessage(
+            height=rs.height, round=rs.round, step=rs.step,
+            seconds_since_start=rs.seconds_since_start,
+            last_commit_round=rs.last_commit_round))
+
+    def _round_step_event(self) -> RoundStepEvent:
+        lcr = self.last_commit.round if self.last_commit else -1
+        # clamp: with skip_timeout_commit the new round starts before
+        # start_time, and the u32 codec cannot carry a negative elapsed
+        elapsed = max(0, int(time.time() - self.start_time))
+        return RoundStepEvent(self.height, self.round, self.step,
+                              elapsed, lcr)
+
+    def _broadcast(self, msg) -> None:
+        if self.broadcast_cb is not None and not self._replay_mode:
+            self.broadcast_cb(msg)
+
+    # ------------------------------------------------------------------
+    # transitions (reference :755-1356)
+    # ------------------------------------------------------------------
+    def _enter_new_round(self, height: int, round_: int) -> None:
+        if (height != self.height or round_ < self.round or
+                (self.round == round_ and self.step != STEP_NEW_HEIGHT)):
+            return
+        if round_ > self.round:
+            validators = self.validators.copy()
+            validators.increment_accum(round_ - self.round)
+            self.validators = validators
+        self.round = round_
+        self.step = STEP_NEW_ROUND
+        if round_ != 0:
+            # new round: drop the previous round's proposal
+            self.proposal = None
+            self.proposal_block = None
+            self.proposal_block_parts = None
+        self.votes.set_round(round_ + 1)
+        self.evsw.fire(ev.NEW_ROUND, self._round_step_event())
+        self._enter_propose(height, round_)
+
+    def _enter_propose(self, height: int, round_: int) -> None:
+        if (height != self.height or round_ < self.round or
+                (self.round == round_ and self.step >= STEP_PROPOSE)):
+            return
+        self.round = round_
+        self._new_step(STEP_PROPOSE)
+        self._ticker.schedule_timeout(TimeoutInfo(
+            height, round_, STEP_PROPOSE, self.cfg.propose_timeout(round_)))
+        if self.is_proposer():
+            self._decide_proposal(height, round_)
+        if self._is_proposal_complete():
+            self._enter_prevote(height, round_)
+
+    def _decide_proposal(self, height: int, round_: int) -> None:
+        """Reference `:899-981` defaultDecideProposal/createProposalBlock."""
+        if self.locked_block is not None:
+            block, parts = self.locked_block, self.locked_block_parts
+        else:
+            block, parts = self._create_proposal_block()
+            if block is None:
+                return
+        # POL metadata comes as a pair from POLInfo — round and block id of
+        # the newest prevote polka together (reference :905-907)
+        pol = self.votes.pol_info()
+        pol_round, pol_block_id = pol if pol is not None else (-1, None)
+        proposal = Proposal(height=height, round=round_,
+                            block_parts_header=parts.header,
+                            pol_round=pol_round, pol_block_id=pol_block_id)
+        try:
+            sig = self.priv_validator.sign_proposal(self.state.chain_id,
+                                                    proposal)
+        except DoubleSignError:
+            return
+        proposal = Proposal(**{**proposal.__dict__, "signature": sig})
+        # loop own messages through the queue (determinism + WAL), and hand
+        # them to the gossip layer
+        self._queue.put((M.ProposalMessage(proposal), ""))
+        self._broadcast(M.ProposalMessage(proposal))
+        for i in range(parts.total):
+            msg = M.BlockPartMessage(height, round_, parts.get_part(i))
+            self._queue.put((msg, ""))
+            self._broadcast(msg)
+
+    def _create_proposal_block(self):
+        """Reference `createProposalBlock` `:961-981`."""
+        if self.height == 1:
+            commit = EMPTY_COMMIT
+        elif self.last_commit is not None and \
+                self.last_commit.has_two_thirds_majority():
+            commit = self.last_commit.make_commit()
+        else:
+            return None, None   # don't have the commit yet
+        txs = self.mempool.reap(self.cfg.max_block_size_txs)
+        block = Block.make(
+            chain_id=self.state.chain_id, height=self.height,
+            time_ns=time.time_ns(), txs=txs, last_commit=commit,
+            last_block_id=self.state.last_block_id,
+            validators_hash=self.state.validators.hash(),
+            app_hash=self.state.app_hash)
+        return block, block.make_part_set()
+
+    def _is_proposal_complete(self) -> bool:
+        if self.proposal is None or self.proposal_block is None:
+            return False
+        if self.proposal.pol_round < 0:
+            return True
+        pv = self.votes.prevotes(self.proposal.pol_round)
+        return pv is not None and pv.has_two_thirds_majority()
+
+    def _enter_prevote(self, height: int, round_: int) -> None:
+        if (height != self.height or round_ < self.round or
+                (self.round == round_ and self.step >= STEP_PREVOTE)):
+            return
+        self.round = round_
+        self._do_prevote(height, round_)
+        self._new_step(STEP_PREVOTE)
+
+    def _do_prevote(self, height: int, round_: int) -> None:
+        """Reference `defaultDoPrevote` `:1015-1047`."""
+        if self.locked_block is not None:
+            self._sign_add_vote(TYPE_PREVOTE,
+                                self._locked_block_id())
+            return
+        if self.proposal_block is None:
+            self._sign_add_vote(TYPE_PREVOTE, ZERO_BLOCK_ID)
+            return
+        try:
+            execution.validate_block(self.state, self.proposal_block)
+        except ValueError:
+            self._sign_add_vote(TYPE_PREVOTE, ZERO_BLOCK_ID)
+            return
+        self._sign_add_vote(TYPE_PREVOTE, BlockID(
+            self.proposal_block.hash(), self.proposal_block_parts.header))
+
+    def _enter_prevote_wait(self, height: int, round_: int) -> None:
+        if (height != self.height or round_ < self.round or
+                (self.round == round_ and self.step >= STEP_PREVOTE_WAIT)):
+            return
+        self.round = round_
+        self._new_step(STEP_PREVOTE_WAIT)
+        self._ticker.schedule_timeout(TimeoutInfo(
+            height, round_, STEP_PREVOTE_WAIT,
+            self.cfg.prevote_timeout(round_)))
+
+    def _enter_precommit(self, height: int, round_: int) -> None:
+        """Lock/unlock rules (reference `:1076-1184`)."""
+        if (height != self.height or round_ < self.round or
+                (self.round == round_ and self.step >= STEP_PRECOMMIT)):
+            return
+        self.round = round_
+        self._new_step(STEP_PRECOMMIT)
+        maj = self.votes.prevotes(round_).two_thirds_majority() \
+            if self.votes.prevotes(round_) else None
+        if maj is None:
+            # no polka: precommit nil, keep any lock
+            self._sign_add_vote(TYPE_PRECOMMIT, ZERO_BLOCK_ID)
+            return
+        self.evsw.fire(ev.POLKA, self._round_step_event())
+        if maj.is_zero():
+            # +2/3 prevoted nil: unlock (reference :1112-1121)
+            if self.locked_block is not None:
+                self.locked_round = -1
+                self.locked_block = None
+                self.locked_block_parts = None
+                self.evsw.fire(ev.UNLOCK, self._round_step_event())
+            self._sign_add_vote(TYPE_PRECOMMIT, ZERO_BLOCK_ID)
+            return
+        if (self.locked_block is not None and
+                self.locked_block.hash() == maj.hash):
+            # relock on the same block at a later round
+            self.locked_round = round_
+            self.evsw.fire(ev.RELOCK, self._round_step_event())
+            self._sign_add_vote(TYPE_PRECOMMIT, maj)
+            return
+        if (self.proposal_block is not None and
+                self.proposal_block.hash() == maj.hash):
+            try:
+                execution.validate_block(self.state, self.proposal_block)
+            except ValueError:
+                # polka for an invalid block!?  precommit nil
+                self._sign_add_vote(TYPE_PRECOMMIT, ZERO_BLOCK_ID)
+                return
+            self.locked_round = round_
+            self.locked_block = self.proposal_block
+            self.locked_block_parts = self.proposal_block_parts
+            self.evsw.fire(ev.LOCK, self._round_step_event())
+            self._sign_add_vote(TYPE_PRECOMMIT, maj)
+            return
+        # polka for a block we don't have: unlock and fetch it
+        self.locked_round = -1
+        self.locked_block = None
+        self.locked_block_parts = None
+        if (self.proposal_block_parts is None or
+                self.proposal_block_parts.header.hash != maj.parts.hash):
+            self.proposal_block = None
+            self.proposal_block_parts = PartSet(maj.parts)
+        self.evsw.fire(ev.UNLOCK, self._round_step_event())
+        self._sign_add_vote(TYPE_PRECOMMIT, ZERO_BLOCK_ID)
+
+    def _enter_precommit_wait(self, height: int, round_: int) -> None:
+        if (height != self.height or round_ < self.round or
+                (self.round == round_ and self.step >= STEP_PRECOMMIT_WAIT)):
+            return
+        self.round = round_
+        self._new_step(STEP_PRECOMMIT_WAIT)
+        self._ticker.schedule_timeout(TimeoutInfo(
+            height, round_, STEP_PRECOMMIT_WAIT,
+            self.cfg.precommit_timeout(round_)))
+
+    def _enter_commit(self, height: int, commit_round: int) -> None:
+        """Reference `:1191-1252`."""
+        if height != self.height or self.step >= STEP_COMMIT:
+            return
+        self.commit_round = commit_round
+        self.commit_time = time.time()
+        self._new_step(STEP_COMMIT)
+        maj = self.votes.precommits(commit_round).two_thirds_majority()
+        assert maj is not None and not maj.is_zero()
+        # promote locked block if it is the committed one
+        if (self.locked_block is not None and
+                self.locked_block.hash() == maj.hash):
+            self.proposal_block = self.locked_block
+            self.proposal_block_parts = self.locked_block_parts
+        if (self.proposal_block is None or
+                self.proposal_block.hash() != maj.hash):
+            if (self.proposal_block_parts is None or
+                    self.proposal_block_parts.header.hash != maj.parts.hash):
+                # wait for the parts to arrive
+                self.proposal_block = None
+                self.proposal_block_parts = PartSet(maj.parts)
+            return
+        self._try_finalize_commit(height)
+
+    def _try_finalize_commit(self, height: int) -> None:
+        maj = self.votes.precommits(self.commit_round).two_thirds_majority()
+        if maj is None or maj.is_zero():
+            return
+        if (self.proposal_block is None or
+                self.proposal_block.hash() != maj.hash):
+            return
+        self._finalize_commit(height)
+
+    def _finalize_commit(self, height: int) -> None:
+        """Reference `finalizeCommit` `:1259-1356`."""
+        if self.step != STEP_COMMIT:
+            return
+        block, parts = self.proposal_block, self.proposal_block_parts
+        maj = self.votes.precommits(self.commit_round).two_thirds_majority()
+        if parts.header != maj.parts:
+            raise RuntimeError("finalize: parts header != +2/3 block id")
+        execution.validate_block(self.state, block)
+        fail_point("consensus.finalizeCommit.validated")
+        if self.block_store.height < block.height:
+            seen_commit = self.votes.precommits(
+                self.commit_round).make_commit()
+            self.block_store.save_block(block, parts, seen_commit)
+        fail_point("consensus.finalizeCommit.savedBlock")
+        if self.wal is not None and not self._replay_mode:
+            self.wal.write_end_height(height)
+        fail_point("consensus.finalizeCommit.waledHeight")
+
+        state_copy = self.state.copy()
+        event_cache = EventCache(self.evsw)
+        execution.apply_block(state_copy, event_cache, self.proxy, block,
+                              parts.header, self.mempool,
+                              tx_indexer=self.tx_indexer)
+        fail_point("consensus.finalizeCommit.applied")
+        event_cache.fire(ev.NEW_BLOCK, block)
+        event_cache.fire(ev.NEW_BLOCK_HEADER, block.header)
+        self._update_to_state(state_copy)
+        event_cache.flush()
+        self._schedule_round_0()
+
+    # ------------------------------------------------------------------
+    # proposal / parts / votes ingestion (reference :1363-1565)
+    # ------------------------------------------------------------------
+    def _set_proposal(self, proposal: Proposal) -> None:
+        if self.proposal is not None:
+            return
+        if proposal.height != self.height or proposal.round != self.round:
+            return
+        if not (-1 <= proposal.pol_round < proposal.round):
+            return
+        ok = self.validators.proposer.pub_key.verify(
+            proposal.sign_bytes(self.state.chain_id), proposal.signature)
+        if not ok:
+            raise ValueError("invalid proposal signature")
+        self.proposal = proposal
+        if (self.proposal_block_parts is None or
+                self.proposal_block_parts.header.hash !=
+                proposal.block_parts_header.hash):
+            self.proposal_block_parts = PartSet(proposal.block_parts_header)
+
+    def _add_proposal_block_part(self, height: int, part) -> None:
+        if height != self.height or self.proposal_block_parts is None:
+            return
+        added = self.proposal_block_parts.add_part(part)
+        if not added:
+            return
+        if self.proposal_block_parts.is_complete():
+            data = self.proposal_block_parts.assemble()
+            try:
+                self.proposal_block = Block.decode_bytes(data)
+            except ValueError:
+                self.proposal_block = None
+                return
+            self.evsw.fire(ev.COMPLETE_PROPOSAL, self._round_step_event())
+            prevotes = self.votes.prevotes(self.round)
+            maj = prevotes.two_thirds_majority() if prevotes else None
+            if maj is not None and not maj.is_zero() and \
+                    self.step == STEP_PREVOTE and \
+                    self.proposal_block.hash() == maj.hash:
+                pass  # handled by vote flow
+            if self.step <= STEP_PROPOSE and self._is_proposal_complete():
+                self._enter_prevote(height, self.round)
+            elif self.step == STEP_COMMIT:
+                self._try_finalize_commit(height)
+
+    def _try_add_vote(self, vote: Vote, peer_id: str) -> None:
+        """Reference `tryAddVote`/`addVote` `:1430-1565`."""
+        # LastCommit vote for the previous height (reference :1466-1491)
+        if vote.height + 1 == self.height:
+            if not (self.step == STEP_NEW_HEIGHT and
+                    vote.type == TYPE_PRECOMMIT and
+                    self.last_commit is not None):
+                return
+            if self.last_commit.add_vote(vote):
+                self._broadcast(M.HasVoteMessage(
+                    vote.height, vote.round, vote.type,
+                    vote.validator_index))
+            return
+        if vote.height != self.height:
+            return
+        added = self.votes.add_vote(vote, peer_id)
+        if not added:
+            return
+        self.evsw.fire(ev.VOTE, vote)
+        self._broadcast(M.HasVoteMessage(vote.height, vote.round, vote.type,
+                                         vote.validator_index))
+        height, round_ = self.height, vote.round
+        if vote.type == TYPE_PREVOTE:
+            prevotes = self.votes.prevotes(round_)
+            maj = prevotes.two_thirds_majority()
+            if maj is not None and self.locked_block is not None and \
+                    self.locked_round < round_ <= self.round and \
+                    not maj.is_zero() and \
+                    self.locked_block.hash() != maj.hash:
+                # POL for another block: unlock (reference :1497-1510)
+                self.locked_round = -1
+                self.locked_block = None
+                self.locked_block_parts = None
+                self.evsw.fire(ev.UNLOCK, self._round_step_event())
+            if round_ > self.round and prevotes.has_two_thirds_any():
+                # round skip: +2/3 prevoting in a future round means the
+                # network moved on (reference :1530-1537)
+                self._enter_new_round(height, round_)
+            if round_ == self.round:
+                if maj is not None and (not maj.is_zero() or
+                                        self.step >= STEP_PREVOTE):
+                    self._enter_precommit(height, round_)
+                elif prevotes.has_two_thirds_any() and \
+                        self.step == STEP_PREVOTE:
+                    self._enter_prevote_wait(height, round_)
+            elif (self.proposal is not None and
+                  0 <= self.proposal.pol_round == round_):
+                if self._is_proposal_complete():
+                    self._enter_prevote(height, self.round)
+        else:  # precommit
+            precommits = self.votes.precommits(round_)
+            maj = precommits.two_thirds_majority()
+            if maj is not None:
+                self._enter_new_round(height, round_)
+                self._enter_precommit(height, round_)
+                if not maj.is_zero():
+                    self._enter_commit(height, round_)
+                    if self.cfg.skip_timeout_commit and \
+                            precommits.has_all():
+                        self._enter_new_round(self.height, 0)
+                else:
+                    self._enter_precommit_wait(height, round_)
+            elif self.round <= round_ and precommits.has_two_thirds_any():
+                self._enter_new_round(height, round_)
+                self._enter_precommit_wait(height, round_)
+
+    def _locked_block_id(self) -> BlockID:
+        return BlockID(self.locked_block.hash(),
+                       self.locked_block_parts.header)
+
+    def _sign_add_vote(self, type_: int, block_id: BlockID) -> None:
+        """Reference `signAddVote` `:1567-1599`."""
+        if self.priv_validator is None or \
+                not self.validators.has_address(self.priv_validator.address):
+            return
+        idx = self.validators.index_of(self.priv_validator.address)
+        vote = Vote(validator_address=self.priv_validator.address,
+                    validator_index=idx, height=self.height,
+                    round=self.round, type=type_, block_id=block_id)
+        try:
+            sig = self.priv_validator.sign_vote(self.state.chain_id, vote)
+        except DoubleSignError:
+            if not self._replay_mode:
+                raise
+            return
+        vote = Vote(**{**vote.__dict__, "signature": sig})
+        # loop back through the queue; also hand to the gossip layer
+        self._queue.put((M.VoteMessage(vote), ""))
+        self._broadcast(M.VoteMessage(vote))
+
+    # ------------------------------------------------------------------
+    # WAL catchup replay (reference consensus/replay.go:97-169)
+    # ------------------------------------------------------------------
+    def _catchup_replay(self) -> None:
+        height = self.height
+        recs = WAL.records_since_height(self.wal.path, height)
+        if recs is None:
+            raise RuntimeError(
+                f"WAL should not contain #ENDHEIGHT {height}")
+        if not recs:
+            # marker for height-1 missing: either a fresh WAL, or the crash
+            # hit the finalize window between save_block and
+            # write_end_height and the handshake already advanced state.
+            # Back-fill the marker so future restarts replay correctly.
+            self.wal.write_end_height(height - 1)
+            return
+        self._replay_mode = True
+        try:
+            for kind, payload in recs:
+                # live mode survives bad peer input (the receive loop
+                # catches); replay must be equally tolerant or one invalid
+                # persisted message crash-loops every restart
+                try:
+                    if kind == REC_MESSAGE:
+                        msg = M.decode_msg(payload)
+                        self._handle_msg(msg, "")
+                    elif kind == REC_TIMEOUT:
+                        h, r, s = struct.unpack(">QIB", payload)
+                        self._handle_timeout(TimeoutInfo(h, r, s))
+                except Exception:
+                    import traceback
+                    traceback.print_exc()
+        finally:
+            self._replay_mode = False

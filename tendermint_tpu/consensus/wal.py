@@ -1,0 +1,97 @@
+"""Consensus write-ahead log: every input persisted before it acts.
+
+Reference: `consensus/wal.go` — timestamped records of round-state events,
+peer messages, and timeouts, fsync'd per write (`Save` `:73-94`);
+`#ENDHEIGHT: n` markers delimit heights (`:97-103`) so recovery knows
+where to resume; `light` mode skips block parts (`:80-87`).
+
+Records here are length-prefixed binary: u32(len) || u8(kind) || payload,
+with a CRC32 per record so a torn tail write is detected and truncated on
+replay rather than crashing recovery.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+
+# record kinds
+REC_ENDHEIGHT = 0x01
+REC_MESSAGE = 0x02       # payload: consensus message (msgs.encode_msg)
+REC_TIMEOUT = 0x03       # payload: TimeoutInfo
+
+
+class WAL:
+    def __init__(self, path: str, light: bool = False):
+        self.path = path
+        self.light = light
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self._f = open(path, "ab")
+
+    # -- writing ---------------------------------------------------------
+    def _write(self, kind: int, payload: bytes) -> None:
+        body = struct.pack(">B", kind) + payload
+        crc = zlib.crc32(body) & 0xFFFFFFFF
+        self._f.write(struct.pack(">II", len(body), crc) + body)
+
+    def save_message(self, payload: bytes) -> None:
+        self._write(REC_MESSAGE, payload)
+        self._sync()
+
+    def save_timeout(self, height: int, round_: int, step: int) -> None:
+        self._write(REC_TIMEOUT, struct.pack(">QIB", height, round_, step))
+        self._sync()
+
+    def write_end_height(self, height: int) -> None:
+        """Reference `:97-103`: marks height as irreversibly committed."""
+        self._write(REC_ENDHEIGHT, struct.pack(">Q", height))
+        self._sync()
+
+    def _sync(self) -> None:
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+    def close(self) -> None:
+        self._f.close()
+
+    # -- reading ---------------------------------------------------------
+    @staticmethod
+    def read_all(path: str) -> list[tuple[int, bytes]]:
+        """All (kind, payload) records; stops cleanly at a torn tail."""
+        out = []
+        if not os.path.exists(path):
+            return out
+        with open(path, "rb") as f:
+            data = f.read()
+        pos = 0
+        while pos + 8 <= len(data):
+            ln, crc = struct.unpack_from(">II", data, pos)
+            if pos + 8 + ln > len(data):
+                break  # torn tail
+            body = data[pos + 8:pos + 8 + ln]
+            if (zlib.crc32(body) & 0xFFFFFFFF) != crc:
+                break  # corrupt tail
+            out.append((body[0], body[1:]))
+            pos += 8 + ln
+        return out
+
+    @staticmethod
+    def records_since_height(path: str, height: int) -> list | None:
+        """Records after `#ENDHEIGHT height-1` for catchup replay
+        (reference `consensus/replay.go:111-169` semantics: returns None if
+        an ENDHEIGHT for `height` itself exists — nothing to replay — and
+        [] if the marker for height-1 is missing entirely)."""
+        recs = WAL.read_all(path)
+        # a marker for `height` means that height fully committed
+        start = None
+        for i, (kind, payload) in enumerate(recs):
+            if kind == REC_ENDHEIGHT:
+                h = struct.unpack(">Q", payload)[0]
+                if h >= height:
+                    return None
+                if h == height - 1:
+                    start = i + 1
+        if start is None:
+            return []
+        return recs[start:]

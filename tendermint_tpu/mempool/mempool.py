@@ -1,0 +1,130 @@
+"""Ordered transaction pool gated by app CheckTx.
+
+Reference: `mempool/mempool.go` — txs enter via CheckTx on the dedicated
+mempool ABCI conn (`:166-205`), LRU dedup cache of 100k (`:51,410-469`),
+`Reap` for proposals (`:298-324`), post-commit `Update` + recheck pipeline
+(`:329-391`), `TxsAvailable` height-gated notification (`:99-104,277-294`),
+and the lock consensus holds across app Commit (`state/execution.go:248`).
+
+The reference's concurrent linked list (tmlibs/clist) becomes an ordered
+dict under one re-entrant lock: iteration order == insertion order, O(1)
+removal on update, safe concurrent CheckTx from RPC threads.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+from tendermint_tpu.types.tx import Tx
+
+
+class Mempool:
+    def __init__(self, proxy_mempool_conn, config=None, wal_path: str = ""):
+        self.proxy = proxy_mempool_conn
+        cache_size = config.cache_size if config else 100_000
+        self.recheck_enabled = config.recheck if config else True
+        self._txs: OrderedDict[bytes, bytes] = OrderedDict()  # hash -> tx
+        self._cache: OrderedDict[bytes, None] = OrderedDict()
+        self._cache_size = cache_size
+        self._lock = threading.RLock()
+        self._height = 0
+        self._notified_available = False
+        self._txs_available_cb = None
+        self._wal_path = wal_path
+        self._wal = open(wal_path, "ab") if wal_path else None
+
+    # -- locking across app Commit (reference state/execution.go:248) ----
+    def lock(self):
+        self._lock.acquire()
+
+    def unlock(self):
+        self._lock.release()
+
+    # -- ingestion -------------------------------------------------------
+    def check_tx(self, tx: bytes):
+        """Admit via app CheckTx; returns the app Result or None when the
+        tx is a cache duplicate (reference `:166-205`).
+
+        The app call happens UNDER the mempool lock: consensus holds this
+        lock across app Commit + update (reference proxyMtx semantics), so
+        no tx can validate against a half-committed app and then slip into
+        the pool after the recheck pass.
+        """
+        h = Tx(tx).hash
+        with self._lock:
+            if h in self._cache:
+                return None
+            self._cache[h] = None
+            while len(self._cache) > self._cache_size:
+                self._cache.popitem(last=False)
+            res = self.proxy.check_tx(tx)
+            if res.is_ok:
+                if self._wal is not None:
+                    self._wal.write(len(tx).to_bytes(4, "big") + tx)
+                    self._wal.flush()
+                self._txs[h] = tx
+                self._notify_available()
+            else:
+                # invalid tx: allow future resubmission (reference :259-264)
+                self._cache.pop(h, None)
+        return res
+
+    def _notify_available(self):
+        if (self._txs_available_cb is not None and
+                not self._notified_available and self._txs):
+            self._notified_available = True
+            self._txs_available_cb(self._height + 1)
+
+    def set_txs_available_callback(self, cb):
+        """Height-gated fire-once-per-height notification
+        (reference `:99-104,277-294`)."""
+        self._txs_available_cb = cb
+
+    # -- queries ---------------------------------------------------------
+    def size(self) -> int:
+        with self._lock:
+            return len(self._txs)
+
+    def reap(self, max_txs: int) -> list[bytes]:
+        """First N txs in order for a proposal (reference `:298-324`)."""
+        with self._lock:
+            out = []
+            for tx in self._txs.values():
+                if 0 <= max_txs <= len(out):
+                    break
+                out.append(tx)
+            return out
+
+    def txs_after(self, n: int) -> list[bytes]:
+        """Gossip helper: txs from position n onward."""
+        with self._lock:
+            return list(self._txs.values())[n:]
+
+    # -- post-commit -----------------------------------------------------
+    def update(self, height: int, committed_txs: list[bytes]) -> None:
+        """Drop committed txs, recheck the rest (reference `:329-391`).
+        Caller (apply_block) already holds the lock."""
+        self._height = height
+        self._notified_available = False
+        for tx in committed_txs:
+            h = Tx(tx).hash
+            self._txs.pop(h, None)
+            self._cache[h] = None   # committed: permanently deduped
+        if self.recheck_enabled and self._txs:
+            survivors = OrderedDict()
+            for h, tx in self._txs.items():
+                if self.proxy.check_tx(tx).is_ok:
+                    survivors[h] = tx
+            self._txs = survivors
+        if self._txs:
+            self._notify_available()
+
+    def flush(self) -> None:
+        with self._lock:
+            self._txs.clear()
+            self._cache.clear()
+
+    def close(self) -> None:
+        if self._wal is not None:
+            self._wal.close()

@@ -51,6 +51,19 @@ def make_commit(privs, vs: ValidatorSet, chain_id: str, height: int,
     return vset.make_commit()
 
 
+def kvstore_app_hashes(n: int, txs_per_block: int = 2) -> list[bytes]:
+    """App hashes for a kvstore app fed build_chain's deterministic txs:
+    entry i is the hash going INTO block i+1."""
+    from tendermint_tpu.abci.app import create_app
+    app = create_app("kvstore")
+    hashes = [b""]
+    for h in range(1, n + 1):
+        for i in range(txs_per_block):
+            app.deliver_tx(b"tx-%d-%d" % (h, i))
+        hashes.append(app.commit().data)
+    return hashes[:-1]
+
+
 def build_chain(privs, vs: ValidatorSet, chain_id: str, n_blocks: int,
                 txs_per_block: int = 2, app_hashes: list[bytes] | None = None,
                 part_size: int = PART_SIZE):

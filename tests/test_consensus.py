@@ -1,0 +1,209 @@
+"""Consensus state machine: solo-validator block production, multi-validator
+in-process nets, locking safety, WAL replay.
+
+Modelled on the reference's `consensus/state_test.go` (driving the machine
+directly with validator stubs) and `consensus/common_test.go`'s in-process
+net harness — here validators are wired broadcast_cb -> feed methods with
+no transport at all.
+"""
+
+import os
+import tempfile
+import threading
+import time
+
+import pytest
+
+from tendermint_tpu.config import test_config as fast_config
+from tendermint_tpu.blockchain.store import BlockStore
+from tendermint_tpu.consensus.state import ConsensusState
+from tendermint_tpu.consensus import messages as M
+from tendermint_tpu.crypto import backend as cb
+from tendermint_tpu.mempool.mempool import Mempool
+from tendermint_tpu.proxy import ClientCreator
+from tendermint_tpu.state.state import get_state
+from tendermint_tpu.types import PrivValidator, PrivKey
+from tendermint_tpu.types import events as ev
+from tendermint_tpu.utils.db import MemDB
+
+from chainutil import make_genesis, make_validators
+
+CHAIN = "cons-chain"
+
+
+@pytest.fixture(autouse=True)
+def _python_backend():
+    old = cb._current
+    cb.set_backend("python")
+    yield
+    cb._current = old
+
+
+def _make_cs(priv, gen, wal_path="", app="kvstore", cfg=None):
+    cfg = cfg or fast_config().consensus
+    db = MemDB()
+    st = get_state(db, gen)
+    conns = ClientCreator(app).new_app_conns()
+    mp = Mempool(conns.mempool)
+    bs = BlockStore(MemDB())
+    cs = ConsensusState(cfg, st, conns.consensus, bs, mp,
+                        priv_validator=priv, wal_path=wal_path)
+    return cs, mp, bs
+
+
+def _wait_height(cs_list, height, timeout=20.0):
+    if not isinstance(cs_list, list):
+        cs_list = [cs_list]
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if all(cs.block_store.height >= height for cs in cs_list):
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def test_solo_validator_makes_blocks():
+    privs, vs = make_validators(1)
+    gen = make_genesis(CHAIN, privs)
+    cs, mp, bs = _make_cs(privs[0], gen)
+    blocks = []
+    cs.evsw.subscribe("t", ev.NEW_BLOCK, blocks.append)
+    cs.start()
+    try:
+        mp.check_tx(b"k1=v1")
+        assert _wait_height(cs, 3), f"stuck at {bs.height}"
+    finally:
+        cs.stop()
+    assert len(blocks) >= 3
+    assert blocks[0].height == 1
+    # the tx landed in an early block
+    all_txs = [tx for b in blocks for tx in b.txs]
+    assert b"k1=v1" in all_txs
+    # state advanced consistently
+    assert cs.state.last_block_height >= 3
+
+
+def _wire_net(n, app="kvstore"):
+    """N consensus states delivering broadcasts directly to each other."""
+    privs, vs = make_validators(n)
+    gen = make_genesis(CHAIN, privs)
+    nodes = []
+    for p in privs:
+        cs, mp, bs = _make_cs(p, gen, app=app)
+        nodes.append(cs)
+
+    def make_cb(me):
+        def cb(msg):
+            for other in nodes:
+                if other is me:
+                    continue
+                if isinstance(msg, M.VoteMessage):
+                    other.add_vote(msg.vote, peer_id="net")
+                elif isinstance(msg, M.ProposalMessage):
+                    other.set_proposal(msg.proposal, peer_id="net")
+                elif isinstance(msg, M.BlockPartMessage):
+                    other.add_proposal_block_part(msg.height, msg.round,
+                                                  msg.part, peer_id="net")
+        return cb
+
+    for cs in nodes:
+        cs.broadcast_cb = make_cb(cs)
+    return nodes
+
+
+def test_four_validators_reach_consensus():
+    nodes = _wire_net(4)
+    for cs in nodes:
+        cs.start()
+    try:
+        nodes[0].mempool.check_tx(b"net=1")
+        ok = _wait_height(nodes, 3, timeout=30)
+        assert ok, f"heights: {[cs.block_store.height for cs in nodes]}"
+        # all agree on block hashes
+        for h in range(1, 4):
+            hashes = {cs.block_store.load_block(h).hash() for cs in nodes}
+            assert len(hashes) == 1, f"disagreement at height {h}"
+        # app-hash agreement is proven by header equality at each height
+        # (header.app_hash covers the previous block's execution); nodes
+        # may legitimately sit at different heights when sampled
+    finally:
+        for cs in nodes:
+            cs.stop()
+
+
+def test_no_progress_without_quorum():
+    """3 of 4 validators offline: chain must not advance."""
+    nodes = _wire_net(4)
+    cs = nodes[0]   # only one started
+    cs.start()
+    try:
+        time.sleep(1.0)
+        assert cs.block_store.height == 0
+        assert cs.state.last_block_height == 0
+    finally:
+        cs.stop()
+
+
+def test_wal_replay_recovers_height(tmp_path):
+    """Crash after commit: restart must resume from the WAL at the right
+    height without double-signing (reference consensus/replay_test.go)."""
+    privs, vs = make_validators(1)
+    gen = make_genesis(CHAIN, privs)
+    wal_path = str(tmp_path / "cs.wal")
+    pv_path = str(tmp_path / "priv.json")
+    priv = PrivValidator(privs[0].priv_key, pv_path)
+    priv.save()
+
+    cs, mp, bs = _make_cs(priv, gen, wal_path=wal_path)
+    cs.start()
+    assert _wait_height(cs, 2)
+    cs.stop()
+    final_state_enc = cs.state.encode()
+    wal_size = os.path.getsize(wal_path)
+    assert wal_size > 0
+
+    # "restart": fresh consensus over the same persisted state + WAL.
+    # state db was in-memory, so rebuild from the persisted snapshot
+    from tendermint_tpu.state.state import State
+    db2 = MemDB()
+    st2 = State.decode_bytes(final_state_enc, db=db2, genesis_doc=gen)
+    st2.save()
+    conns = ClientCreator("kvstore").new_app_conns()
+    # replay app to its height (handshake responsibility, done manually)
+    for h in range(1, st2.last_block_height + 1):
+        blk = cs.block_store.load_block(h)
+        from tendermint_tpu.state.execution import exec_commit_block
+        exec_commit_block(conns.consensus, blk)
+    priv2 = PrivValidator.load(pv_path)
+    mp2 = Mempool(conns.mempool)
+    cs2 = ConsensusState(fast_config().consensus, st2, conns.consensus,
+                         cs.block_store, mp2, priv_validator=priv2,
+                         wal_path=wal_path)
+    start_height = cs2.height
+    assert start_height == st2.last_block_height + 1
+    cs2.start()
+    try:
+        assert _wait_height(cs2, start_height, timeout=20)
+    finally:
+        cs2.stop()
+
+
+def test_proposal_flow_events():
+    privs, vs = make_validators(1)
+    gen = make_genesis(CHAIN, privs)
+    cs, mp, bs = _make_cs(privs[0], gen)
+    steps = []
+    cs.evsw.subscribe("t", ev.NEW_ROUND_STEP,
+                      lambda rs: steps.append(rs.step))
+    cs.start()
+    try:
+        assert _wait_height(cs, 1)
+    finally:
+        cs.stop()
+    # propose -> prevote -> precommit -> commit in order for height 1
+    from tendermint_tpu.consensus.state import (STEP_COMMIT, STEP_PRECOMMIT,
+                                                STEP_PREVOTE, STEP_PROPOSE)
+    for want in [STEP_PROPOSE, STEP_PREVOTE, STEP_PRECOMMIT, STEP_COMMIT]:
+        assert want in steps
+    assert steps.index(STEP_PROPOSE) < steps.index(STEP_PREVOTE) \
+        < steps.index(STEP_PRECOMMIT) < steps.index(STEP_COMMIT)

@@ -1,0 +1,74 @@
+"""Mempool semantics: ordering, dedup, reap, post-commit recheck.
+
+Reference: `mempool/mempool_test.go` (204 LoC).
+"""
+
+from tendermint_tpu.abci.app import create_app
+from tendermint_tpu.mempool.mempool import Mempool
+from tendermint_tpu.proxy import ClientCreator
+
+
+def _mp(app="counter_serial"):
+    conns = ClientCreator(app).new_app_conns()
+    return Mempool(conns.mempool), conns
+
+
+def test_order_and_reap():
+    mp, _ = _mp(app="kvstore")
+    for i in range(10):
+        assert mp.check_tx(b"k%d=v" % i).is_ok
+    assert mp.size() == 10
+    assert mp.reap(3) == [b"k0=v", b"k1=v", b"k2=v"]
+    assert len(mp.reap(-1)) == 10
+
+
+def test_cache_dedup():
+    mp, _ = _mp(app="kvstore")
+    assert mp.check_tx(b"dup=1").is_ok
+    assert mp.check_tx(b"dup=1") is None      # cache hit
+    assert mp.size() == 1
+
+
+def test_rejected_tx_not_pooled_and_retryable():
+    mp, conns = _mp(app="counter_serial")
+    # serial counter: nonce must be >= count; tx "5" ok, huge tx rejected
+    assert mp.check_tx((0).to_bytes(8, "big")).is_ok
+    res = mp.check_tx(b"x" * 9)               # too long -> encoding error
+    assert res is not None and not res.is_ok
+    assert mp.size() == 1
+    # rejected txs leave the cache so they can be retried later
+    res2 = mp.check_tx(b"x" * 9)
+    assert res2 is not None                   # not swallowed by the cache
+
+
+def test_update_removes_committed_and_rechecks():
+    mp, conns = _mp(app="counter_serial")
+    txs = [(i).to_bytes(8, "big") for i in range(4)]
+    for t in txs:
+        assert mp.check_tx(t).is_ok
+    assert mp.size() == 4
+    # commit txs 0..1 -> app count advances to 2
+    for t in txs[:2]:
+        conns.consensus.deliver_tx(t)
+    conns.consensus.commit()
+    mp.lock()
+    mp.update(1, txs[:2])
+    mp.unlock()
+    # recheck pass: txs 2,3 still valid (nonce >= 2)
+    assert mp.size() == 2
+    assert mp.reap(-1) == txs[2:]
+    # committed txs are permanently deduped
+    assert mp.check_tx(txs[0]) is None
+
+
+def test_txs_available_height_gated():
+    mp, _ = _mp(app="kvstore")
+    fired = []
+    mp.set_txs_available_callback(fired.append)
+    mp.check_tx(b"a=1")
+    mp.check_tx(b"b=2")
+    assert fired == [1]          # once per height, not per tx
+    mp.lock()
+    mp.update(1, [b"a=1"])
+    mp.unlock()
+    assert fired == [1, 2]       # leftover tx b=2 re-arms for height 2
