@@ -1,0 +1,217 @@
+"""Command-line interface.
+
+Reference: `cmd/tendermint/commands/` — `init`, `node`, `testnet`,
+`gen_validator`, `show_validator`, `replay`, `unsafe_reset_all`,
+`version` (file-per-command, root at `root.go:36-52`).  argparse-based;
+every command takes --home.
+
+Run as `python -m tendermint_tpu.cli <command>`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+
+from tendermint_tpu import __version__
+from tendermint_tpu.config import Config
+
+
+def _load_config(args) -> Config:
+    cfg = Config()
+    cfg.base.home = args.home
+    if getattr(args, "proxy_app", None):
+        cfg.base.proxy_app = args.proxy_app
+    if getattr(args, "chain_id", None):
+        cfg.base.chain_id = args.chain_id
+    if getattr(args, "rpc_laddr", None):
+        cfg.rpc.laddr = args.rpc_laddr
+    if getattr(args, "p2p_laddr", None):
+        cfg.p2p.laddr = args.p2p_laddr
+    if getattr(args, "seeds", None):
+        cfg.p2p.seeds = args.seeds.split(",")
+    if getattr(args, "crypto_backend", None):
+        cfg.base.crypto_backend = args.crypto_backend
+    if getattr(args, "fast_sync", None) is not None:
+        cfg.base.fast_sync = args.fast_sync
+    return cfg
+
+
+def cmd_init(args) -> int:
+    """Initialize home dir: priv validator + solo-validator genesis
+    (reference cmd/tendermint/commands/init.go)."""
+    from tendermint_tpu.types import GenesisDoc, GenesisValidator, PrivValidator
+    cfg = _load_config(args)
+    root = cfg.base.root()
+    os.makedirs(root, exist_ok=True)
+    pv_file = cfg.base.priv_validator_file()
+    pv = PrivValidator.load_or_generate(pv_file)
+    gen_file = cfg.base.genesis_file()
+    if not os.path.exists(gen_file):
+        doc = GenesisDoc(
+            chain_id=args.chain_id or "test-chain",
+            validators=[GenesisValidator(pv.pub_key.bytes_, 10)])
+        doc.save(gen_file)
+        print(f"genesis written to {gen_file}")
+    else:
+        print(f"genesis already exists at {gen_file}")
+    print(f"priv validator at {pv_file} ({pv.address.hex()})")
+    return 0
+
+
+def cmd_node(args) -> int:
+    """Run the node (reference run_node.go)."""
+    from tendermint_tpu.node.node import Node
+    cfg = _load_config(args)
+    node = Node(cfg)
+    node.start()
+
+    from tendermint_tpu.types import events as ev
+
+    def on_block(block):
+        print(f"committed block height={block.height} "
+              f"txs={len(block.txs)} hash={block.hash().hex()[:12]}",
+              flush=True)
+
+    node.evsw.subscribe("cli", ev.NEW_BLOCK, on_block)
+    rpc = node.rpc_server.addr if node.rpc_server else "disabled"
+    print(f"node started: chain={node.state.chain_id} rpc={rpc}",
+          flush=True)
+    node.run_forever()
+    return 0
+
+
+def cmd_testnet(args) -> int:
+    """Generate N validator home dirs sharing one genesis
+    (reference testnet.go:14-50)."""
+    from tendermint_tpu.types import GenesisDoc, GenesisValidator, PrivValidator
+    n = args.n
+    out = args.output
+    os.makedirs(out, exist_ok=True)
+    pvs = []
+    for i in range(n):
+        home = os.path.join(out, f"node{i}")
+        os.makedirs(home, exist_ok=True)
+        pv = PrivValidator.load_or_generate(
+            os.path.join(home, "priv_validator.json"))
+        pvs.append(pv)
+    doc = GenesisDoc(
+        chain_id=args.chain_id or "testnet-chain",
+        validators=[GenesisValidator(pv.pub_key.bytes_, 10) for pv in pvs])
+    for i in range(n):
+        doc.save(os.path.join(out, f"node{i}", "genesis.json"))
+    print(f"wrote {n} node homes under {out}")
+    return 0
+
+
+def cmd_gen_validator(args) -> int:
+    from tendermint_tpu.types import PrivValidator
+    pv = PrivValidator.generate()
+    print(json.dumps({"address": pv.address.hex(),
+                      "pub_key": pv.pub_key.bytes_.hex(),
+                      "priv_key": pv.priv_key.seed.hex()}, indent=2))
+    return 0
+
+
+def cmd_show_validator(args) -> int:
+    from tendermint_tpu.types import PrivValidator
+    cfg = _load_config(args)
+    pv = PrivValidator.load(cfg.base.priv_validator_file())
+    print(json.dumps({"address": pv.address.hex(),
+                      "pub_key": pv.pub_key.bytes_.hex()}, indent=2))
+    return 0
+
+
+def cmd_unsafe_reset_all(args) -> int:
+    """Wipe data + reset priv validator HRS (reference
+    reset_priv_validator.go)."""
+    from tendermint_tpu.types import PrivValidator
+    cfg = _load_config(args)
+    data = cfg.base.db_dir()
+    if os.path.isdir(data):
+        shutil.rmtree(data)
+        print(f"removed {data}")
+    pv_file = cfg.base.priv_validator_file()
+    if os.path.exists(pv_file):
+        pv = PrivValidator.load(pv_file)
+        pv.reset()
+        print(f"reset priv validator signing state at {pv_file}")
+    return 0
+
+
+def cmd_replay(args) -> int:
+    """Replay stored blocks through a fresh app (reference replay.go)."""
+    from tendermint_tpu.blockchain.store import BlockStore
+    from tendermint_tpu.proxy import ClientCreator
+    from tendermint_tpu.state.execution import exec_commit_block
+    from tendermint_tpu.utils.db import new_db
+    cfg = _load_config(args)
+    bs = BlockStore(new_db("sqlite",
+                           os.path.join(cfg.base.db_dir(),
+                                        "blockstore.db")))
+    conns = ClientCreator(cfg.base.proxy_app).new_app_conns()
+    print(f"replaying {bs.height} blocks into {cfg.base.proxy_app}")
+    app_hash = b""
+    for h in range(1, bs.height + 1):
+        block = bs.load_block(h)
+        app_hash = exec_commit_block(conns.consensus, block)
+    print(f"done; final app hash {app_hash.hex()}")
+    return 0
+
+
+def cmd_version(args) -> int:
+    print(__version__)
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="tendermint_tpu",
+                                description="TPU-native BFT replication")
+    p.add_argument("--home", default=os.environ.get("TM_HOME",
+                                                    "~/.tendermint_tpu"))
+    sub = p.add_subparsers(dest="command", required=True)
+
+    sp = sub.add_parser("init", help="initialize home dir")
+    sp.add_argument("--chain-id", default="")
+    sp.set_defaults(fn=cmd_init)
+
+    sp = sub.add_parser("node", help="run the node")
+    sp.add_argument("--proxy-app", dest="proxy_app", default="")
+    sp.add_argument("--chain-id", default="")
+    sp.add_argument("--rpc-laddr", dest="rpc_laddr", default="")
+    sp.add_argument("--p2p-laddr", dest="p2p_laddr", default="")
+    sp.add_argument("--seeds", default="")
+    sp.add_argument("--crypto-backend", dest="crypto_backend", default="")
+    sp.add_argument("--fast-sync", dest="fast_sync", action="store_true",
+                    default=None)
+    sp.add_argument("--no-fast-sync", dest="fast_sync",
+                    action="store_false")
+    sp.set_defaults(fn=cmd_node)
+
+    sp = sub.add_parser("testnet", help="generate a local testnet")
+    sp.add_argument("--n", type=int, default=4)
+    sp.add_argument("--output", default="./mytestnet")
+    sp.add_argument("--chain-id", default="")
+    sp.set_defaults(fn=cmd_testnet)
+
+    sp = sub.add_parser("gen_validator", help="print a fresh key")
+    sp.set_defaults(fn=cmd_gen_validator)
+
+    sp = sub.add_parser("show_validator", help="print this node's key")
+    sp.set_defaults(fn=cmd_show_validator)
+
+    sp = sub.add_parser("unsafe_reset_all", help="wipe data dir")
+    sp.set_defaults(fn=cmd_unsafe_reset_all)
+
+    sp = sub.add_parser("replay", help="replay blocks into the app")
+    sp.add_argument("--proxy-app", dest="proxy_app", default="")
+    sp.set_defaults(fn=cmd_replay)
+
+    sp = sub.add_parser("version", help="print version")
+    sp.set_defaults(fn=cmd_version)
+
+    args = p.parse_args(argv)
+    return args.fn(args)

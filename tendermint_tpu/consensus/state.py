@@ -626,6 +626,11 @@ class ConsensusState:
                 self._broadcast(M.HasVoteMessage(
                     vote.height, vote.round, vote.type,
                     vote.validator_index))
+                # straggler completed the last commit: skip timeout_commit
+                # (reference :1475-1480)
+                if self.cfg.skip_timeout_commit and \
+                        self.last_commit.has_all():
+                    self._enter_new_round(self.height, 0)
             return
         if vote.height != self.height:
             return
@@ -639,45 +644,45 @@ class ConsensusState:
         if vote.type == TYPE_PREVOTE:
             prevotes = self.votes.prevotes(round_)
             maj = prevotes.two_thirds_majority()
+            # unlock on a valid POL: lockRound < POLRound <= current round
+            # (reference :1497-1512 — a nil polka also unlocks)
             if maj is not None and self.locked_block is not None and \
                     self.locked_round < round_ <= self.round and \
-                    not maj.is_zero() and \
                     self.locked_block.hash() != maj.hash:
-                # POL for another block: unlock (reference :1497-1510)
                 self.locked_round = -1
                 self.locked_block = None
                 self.locked_block_parts = None
                 self.evsw.fire(ev.UNLOCK, self._round_step_event())
-            if round_ > self.round and prevotes.has_two_thirds_any():
-                # round skip: +2/3 prevoting in a future round means the
-                # network moved on (reference :1530-1537)
+            if self.round <= round_ and prevotes.has_two_thirds_any():
+                # round-skip to PrevoteWait or straight to Precommit
+                # (reference :1513-1522)
                 self._enter_new_round(height, round_)
-            if round_ == self.round:
-                if maj is not None and (not maj.is_zero() or
-                                        self.step >= STEP_PREVOTE):
+                if maj is not None:
                     self._enter_precommit(height, round_)
-                elif prevotes.has_two_thirds_any() and \
-                        self.step == STEP_PREVOTE:
+                else:
+                    self._enter_prevote(height, round_)
                     self._enter_prevote_wait(height, round_)
             elif (self.proposal is not None and
                   0 <= self.proposal.pol_round == round_):
                 if self._is_proposal_complete():
                     self._enter_prevote(height, self.round)
-        else:  # precommit
+        else:  # precommit (reference :1528-1554)
             precommits = self.votes.precommits(round_)
             maj = precommits.two_thirds_majority()
             if maj is not None:
-                self._enter_new_round(height, round_)
-                self._enter_precommit(height, round_)
-                if not maj.is_zero():
+                if maj.is_zero():
+                    # nil majority: the round is dead, move on immediately
+                    self._enter_new_round(height, round_ + 1)
+                else:
+                    self._enter_new_round(height, round_)
+                    self._enter_precommit(height, round_)
                     self._enter_commit(height, round_)
                     if self.cfg.skip_timeout_commit and \
                             precommits.has_all():
                         self._enter_new_round(self.height, 0)
-                else:
-                    self._enter_precommit_wait(height, round_)
             elif self.round <= round_ and precommits.has_two_thirds_any():
                 self._enter_new_round(height, round_)
+                self._enter_precommit(height, round_)
                 self._enter_precommit_wait(height, round_)
 
     def _locked_block_id(self) -> BlockID:

@@ -1,0 +1,156 @@
+"""Node: the composition root wiring every subsystem together.
+
+Reference: `node/node.go` — `NewNode` (`:68-236`) builds DBs, state,
+handshake, proxy app conns, mempool, consensus, reactors, switch, and RPC;
+`OnStart` (`:238-271`) brings up the listener, reactors, and RPC servers;
+`RunForever` (`:288`).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from tendermint_tpu.blockchain.store import BlockStore
+from tendermint_tpu.config import Config
+from tendermint_tpu.consensus.replay import Handshaker
+from tendermint_tpu.consensus.state import ConsensusState
+from tendermint_tpu.crypto import backend as crypto_backend
+from tendermint_tpu.mempool.mempool import Mempool
+from tendermint_tpu.proxy import ClientCreator
+from tendermint_tpu.state.state import get_state
+from tendermint_tpu.state.txindex import KVTxIndexer, NullTxIndexer
+from tendermint_tpu.types import GenesisDoc, PrivValidator
+from tendermint_tpu.types.events import EventSwitch
+from tendermint_tpu.utils.db import new_db
+
+
+class Node:
+    def __init__(self, config: Config,
+                 priv_validator: PrivValidator | None = None,
+                 genesis_doc: GenesisDoc | None = None,
+                 app=None):
+        """Build everything (reference `NewNode` node/node.go:68-236).
+
+        `app` overrides config.base.proxy_app with an Application instance
+        (in-process custom apps, tests).
+        """
+        self.config = config
+        base = config.base
+        crypto_backend.set_backend(base.crypto_backend)
+
+        # --- storage (reference :70-77) ---
+        if base.db_backend == "memdb":
+            mk = lambda name: new_db("memdb")
+        else:
+            os.makedirs(base.db_dir(), exist_ok=True)
+            mk = lambda name: new_db("sqlite",
+                                     os.path.join(base.db_dir(),
+                                                  name + ".db"))
+        self.block_store_db = mk("blockstore")
+        self.state_db = mk("state")
+
+        # --- genesis + state (reference :78) ---
+        self.genesis_doc = genesis_doc or GenesisDoc.load(base.genesis_file())
+        initial_state = get_state(self.state_db, self.genesis_doc)
+        self.block_store = BlockStore(self.block_store_db)
+
+        # --- priv validator ---
+        self.priv_validator = priv_validator
+        if self.priv_validator is None and base.db_backend != "memdb":
+            self.priv_validator = PrivValidator.load_or_generate(
+                base.priv_validator_file())
+
+        # --- app conns + handshake (reference :83-89) ---
+        self.proxy_app = ClientCreator(
+            app if app is not None else base.proxy_app).new_app_conns()
+        self.handshaker = Handshaker(initial_state, self.block_store)
+        self.handshaker.handshake(self.proxy_app)
+
+        # --- events, mempool, tx index, consensus (reference :96-158) ---
+        self.evsw = EventSwitch()
+        self.mempool = Mempool(self.proxy_app.mempool, config.mempool)
+        self.tx_indexer = (KVTxIndexer(mk("tx_index"))
+                           if base.db_backend != "memdb"
+                           else KVTxIndexer(new_db("memdb")))
+        wal_path = (os.path.join(base.db_dir(), "cs.wal")
+                    if base.db_backend != "memdb" else "")
+        self.consensus = ConsensusState(
+            config.consensus, initial_state, self.proxy_app.consensus,
+            self.block_store, self.mempool,
+            priv_validator=self.priv_validator, evsw=self.evsw,
+            wal_path=wal_path, tx_indexer=self.tx_indexer)
+
+        # --- p2p switch (built when a listen addr is configured) ---
+        self.switch = None
+        self._maybe_build_p2p()
+
+        # --- RPC ---
+        self.rpc_server = None
+        self._stopped = threading.Event()
+
+    @property
+    def state(self):
+        """The LIVE state: consensus swaps in a fresh State copy on every
+        commit, so RPC must read through it rather than hold the boot-time
+        object."""
+        return self.consensus.state
+
+    def _maybe_build_p2p(self) -> None:
+        """Wire the p2p stack when available; solo nodes skip it
+        (reference runs alone with fast_sync off, node/node.go:117-125)."""
+        try:
+            from tendermint_tpu.node.p2p_setup import build_p2p
+        except ImportError:
+            return
+        if self.config.p2p.laddr:
+            self.switch = build_p2p(self)
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> None:
+        """Reference `OnStart` node/node.go:238-271."""
+        if self.switch is not None:
+            self.switch.start()   # reactors own consensus startup
+        else:
+            self.consensus.start()
+        if self.config.rpc.laddr:
+            from tendermint_tpu.rpc.server import RPCServer
+            self.rpc_server = RPCServer(self, self.config.rpc)
+            self.rpc_server.start()
+
+    def stop(self) -> None:
+        self._stopped.set()
+        if self.rpc_server is not None:
+            self.rpc_server.stop()
+        if self.switch is not None:
+            self.switch.stop()
+        self.consensus.stop()
+        self.mempool.close()
+
+    def run_forever(self) -> None:
+        """Reference `RunForever` node/node.go:288."""
+        try:
+            while not self._stopped.wait(0.5):
+                pass
+        except KeyboardInterrupt:
+            self.stop()
+
+    # -- introspection for RPC ------------------------------------------
+    def status(self) -> dict:
+        latest_height = self.block_store.height
+        meta = self.block_store.load_block_meta(latest_height) \
+            if latest_height else None
+        return {
+            "node_info": {
+                "moniker": self.config.base.moniker,
+                "network": self.state.chain_id,
+                "version": "0.1.0",
+            },
+            "pub_key": (self.priv_validator.pub_key.hex()
+                        if self.priv_validator else None),
+            "latest_block_height": latest_height,
+            "latest_block_hash": (meta.block_id.hash.hex() if meta else ""),
+            "latest_app_hash": self.state.app_hash.hex(),
+            "validator_count": self.state.validators.size(),
+            "consensus": self.consensus.get_round_state_summary(),
+        }

@@ -1,0 +1,77 @@
+"""CLI end-to-end: init / node / restart as real subprocesses.
+
+Reference: `test/persist/` scripts — start a node, kill it, restart,
+assert it resumes committing blocks at a later height.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+ENV = {**os.environ, "TM_CRYPTO_BACKEND": "python",
+       "JAX_PLATFORMS": "cpu", "PYTHONUNBUFFERED": "1"}
+
+
+def _rpc(port, method, timeout=2.0):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/{method}", timeout=timeout) as r:
+        return json.loads(r.read())["result"]
+
+
+def _wait_rpc_height(port, height, timeout=30.0):
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        try:
+            st = _rpc(port, "status")
+            last = st["latest_block_height"]
+            if last >= height:
+                return last
+        except OSError:
+            pass
+        time.sleep(0.1)
+    raise AssertionError(f"node stuck at height {last}")
+
+
+def _start_node(home, port):
+    return subprocess.Popen(
+        [sys.executable, "-m", "tendermint_tpu.cli", "--home", home,
+         "node", "--rpc-laddr", f"tcp://127.0.0.1:{port}",
+         "--crypto-backend", "python"],
+        env=ENV, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+@pytest.mark.slow
+def test_init_run_kill_restart(tmp_path):
+    home = str(tmp_path / "home")
+    port = 27657
+    out = subprocess.run(
+        [sys.executable, "-m", "tendermint_tpu.cli", "--home", home,
+         "init", "--chain-id", "cli-chain"],
+        env=ENV, capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert out.returncode == 0, out.stderr
+    assert os.path.exists(os.path.join(home, "genesis.json"))
+
+    proc = _start_node(home, port)
+    try:
+        h1 = _wait_rpc_height(port, 2)
+        # hard kill (crash)
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=10)
+        # restart: must handshake + resume past the previous height
+        proc = _start_node(home, port)
+        h2 = _wait_rpc_height(port, h1 + 2)
+        assert h2 > h1
+        st = _rpc(port, "status")
+        assert st["node_info"]["network"] == "cli-chain"
+    finally:
+        proc.kill()
+        proc.wait(timeout=10)

@@ -1,0 +1,135 @@
+"""Node + RPC end-to-end: the minimum slice (SURVEY.md §7 phase 4).
+
+Reference: `rpc/rpc_test.go` + `test/app/` — a live node serving
+JSON-RPC/URI/WebSocket with broadcast_tx_commit landing txs in blocks.
+"""
+
+import threading
+import time
+
+import pytest
+
+from tendermint_tpu.config import test_config as fast_config
+from tendermint_tpu.node.node import Node
+from tendermint_tpu.rpc.client import HTTPClient, LocalClient, RPCError, WSClient
+from tendermint_tpu.types import GenesisDoc, GenesisValidator, PrivValidator, PrivKey
+
+CHAIN = "rpc-chain"
+
+
+@pytest.fixture(scope="module")
+def node():
+    cfg = fast_config()
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+    cfg.p2p.laddr = ""
+    pv = PrivValidator(PrivKey(b"\x11" * 32))
+    gen = GenesisDoc(chain_id=CHAIN,
+                     validators=[GenesisValidator(pv.pub_key.bytes_, 10)],
+                     genesis_time_ns=1)
+    n = Node(cfg, priv_validator=pv, genesis_doc=gen)
+    n.start()
+    # wait for first blocks
+    deadline = time.time() + 20
+    while time.time() < deadline and n.block_store.height < 1:
+        time.sleep(0.01)
+    assert n.block_store.height >= 1
+    yield n
+    n.stop()
+
+
+@pytest.fixture
+def client(node):
+    return HTTPClient(node.rpc_server.addr)
+
+
+def test_status(node, client):
+    st = client.status()
+    assert st["node_info"]["network"] == CHAIN
+    assert st["latest_block_height"] >= 1
+    assert st["validator_count"] == 1
+
+
+def test_broadcast_tx_commit_lands(node, client):
+    res = client.broadcast_tx_commit(tx="0x" + b"rpc=42".hex())
+    assert res["check_tx"]["code"] == 0
+    assert res["deliver_tx"]["code"] == 0
+    assert res["height"] >= 1
+    # query the app for the value
+    q = client.abci_query(data=b"rpc".hex())
+    assert bytes.fromhex(q["value"]) == b"42"
+    # tx index lookup
+    tx_res = client.tx(hash=res["hash"])
+    assert tx_res["height"] == res["height"]
+    assert bytes.fromhex(tx_res["tx"]) == b"rpc=42"
+
+
+def test_block_and_blockchain_routes(node, client):
+    client.broadcast_tx_commit(tx="0x" + b"r2=1".hex())
+    h = node.block_store.height
+    blk = client.block(height=h)
+    assert blk["block"]["header"]["height"] == h
+    bc = client.blockchain()
+    assert bc["last_height"] >= h
+    assert bc["block_metas"][0]["height"] == bc["last_height"]
+    cm = client.commit(height=h)
+    assert cm["precommits"] == 1
+    vals = client.validators()
+    assert len(vals["validators"]) == 1
+    gen = client.genesis()
+    assert gen["genesis"]["chain_id"] == CHAIN
+    dump = client.dump_consensus_state()
+    assert dump["round_state"]["height"] >= h
+
+
+def test_uri_get_endpoints(node):
+    import json
+    import urllib.request
+    addr = node.rpc_server.addr
+    with urllib.request.urlopen(f"{addr}/status") as r:
+        out = json.loads(r.read())
+    assert out["result"]["latest_block_height"] >= 1
+    with urllib.request.urlopen(f"{addr}/num_unconfirmed_txs") as r:
+        out = json.loads(r.read())
+    assert "n_txs" in out["result"]
+    # root lists routes
+    with urllib.request.urlopen(addr) as r:
+        out = json.loads(r.read())
+    assert "status" in out["routes"]
+
+
+def test_unknown_method_and_errors(node, client):
+    with pytest.raises(RPCError, match="unknown method"):
+        client.call("not_a_method")
+    with pytest.raises(RPCError, match="no block"):
+        client.block(height=10_000_000)
+
+
+def test_websocket_new_block_subscription(node):
+    from tendermint_tpu.types import events as ev
+    ws = WSClient(node.rpc_server.addr)
+    try:
+        ws.subscribe(ev.NEW_BLOCK)
+        msg = ws.recv()
+        assert msg["method"] == "event"
+        assert msg["params"]["event"] == ev.NEW_BLOCK
+        assert msg["params"]["data"]["height"] >= 1
+        # status over the same ws connection
+    finally:
+        ws.close()
+
+
+def test_local_client(node):
+    lc = LocalClient(node)
+    st = lc.status()
+    assert st["latest_block_height"] >= 1
+
+
+def test_status_reports_live_state(node, client):
+    """Regression: Node.state must track consensus's per-commit State
+    swap; the boot-time snapshot would report a stale app hash forever."""
+    before = client.status()
+    client.broadcast_tx_commit(tx="0x" + b"live=state".hex())
+    after = client.status()
+    assert after["latest_block_height"] > before["latest_block_height"]
+    assert after["latest_app_hash"] != before["latest_app_hash"]
+    assert after["latest_app_hash"] == node.consensus.state.app_hash.hex()
