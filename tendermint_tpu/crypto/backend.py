@@ -66,6 +66,7 @@ class TpuBackend:
         # import lazily so the python backend works without jax configured
         import jax.numpy as jnp
         from tendermint_tpu.ops import ed25519 as dev
+        _enable_compile_cache()
         self._jnp = jnp
         self._dev = dev
 
@@ -83,6 +84,30 @@ class TpuBackend:
         out = self._dev.verify_batch(jnp.asarray(pubkeys), jnp.asarray(msgs),
                                      jnp.asarray(sigs))
         return np.asarray(out)[:n]
+
+
+_cache_enabled = False
+
+
+def _enable_compile_cache() -> None:
+    """Persistent XLA compilation cache: the ed25519/merkle graphs take
+    30-120s to compile cold, which would otherwise be paid again on every
+    node restart (the restart path JITs during WAL replay)."""
+    global _cache_enabled
+    if _cache_enabled:
+        return
+    _cache_enabled = True
+    import jax
+    cache_dir = os.environ.get(
+        "TM_JAX_CACHE_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "tendermint_tpu",
+                     "jax"))
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass  # cache is an optimization; never block startup on it
 
 
 _BACKENDS = {

@@ -99,12 +99,17 @@ class Node:
     def _maybe_build_p2p(self) -> None:
         """Wire the p2p stack when available; solo nodes skip it
         (reference runs alone with fast_sync off, node/node.go:117-125)."""
+        if not self.config.p2p.laddr:
+            return
         try:
             from tendermint_tpu.node.p2p_setup import build_p2p
         except ImportError:
+            import sys
+            print("WARNING: p2p.laddr is set but the p2p stack is "
+                  "unavailable; running solo with no networking",
+                  file=sys.stderr)
             return
-        if self.config.p2p.laddr:
-            self.switch = build_p2p(self)
+        self.switch = build_p2p(self)
 
     # -- lifecycle ------------------------------------------------------
     def start(self) -> None:

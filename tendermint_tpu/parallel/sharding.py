@@ -24,11 +24,20 @@ from tendermint_tpu.ops import ed25519 as _ed
 from tendermint_tpu.ops import merkle as _merkle
 
 
-def make_mesh(n_devices: int | None = None, axis: str = "batch") -> Mesh:
-    devs = jax.devices()
+def make_mesh(n_devices: int | None = None, axis: str = "batch",
+              platform: str | None = None) -> Mesh:
+    """1-D device mesh.  `platform` pins a backend (e.g. "cpu" for the
+    virtual-device dry run under --xla_force_host_platform_device_count);
+    default: the default platform, erroring rather than silently falling
+    back when it has too few devices."""
+    devs = jax.devices(platform) if platform else jax.devices()
     n = n_devices or len(devs)
     if len(devs) < n:
-        raise ValueError(f"need {n} devices, have {len(devs)}")
+        raise ValueError(
+            f"need {n} devices, have {len(devs)}"
+            + ("" if platform else
+               ' (pass platform="cpu" for a virtual mesh under '
+               "--xla_force_host_platform_device_count)"))
     return Mesh(np.array(devs[:n]), (axis,))
 
 

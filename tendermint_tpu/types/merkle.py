@@ -87,7 +87,8 @@ class Proof:
             return False
         try:
             return self.compute_root() == expected_root
-        except ValueError:
+        except (ValueError, IndexError):
+            # IndexError: proof carries fewer aunts than the path depth
             return False
 
 

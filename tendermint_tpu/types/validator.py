@@ -170,9 +170,12 @@ class ValidatorSet:
         """Flatten a commit into verify arrays so callers can batch many
         commits into one device call.
 
-        Returns (pubs[N,32], msgs[N,128], sigs[N,64], powers[N]) for the
-        precommits that vote for `block_id` at (height, commit.round); a
-        structural error in any precommit raises ValueError.
+        Returns (pubs[N,32], msgs[N,128], sigs[N,64], powers[N]) covering
+        EVERY non-nil precommit at (height, commit.round) — all signatures
+        must verify, matching the reference's VerifyCommit which rejects a
+        commit carrying any invalid signature — with powers[i] = 0 for
+        precommits voting a different block (verified but not tallied).
+        A structural error in any precommit raises ValueError.
         """
         if self.size() != commit.size():
             raise ValueError(
@@ -197,12 +200,11 @@ class ValidatorSet:
             val = self.validators[idx]
             if val.address != v.validator_address:
                 raise ValueError(f"commit vote {idx} address mismatch")
-            if v.block_id.key() != block_id.key():
-                continue  # valid precommit for another block: not tallied
             pubs.append(val.pub_key.bytes_)
             msgs.append(v.sign_bytes(chain_id))
             sigs.append(v.signature)
-            powers.append(val.voting_power)
+            powers.append(val.voting_power
+                          if v.block_id.key() == block_id.key() else 0)
         n = len(pubs)
         return (
             np.frombuffer(b"".join(pubs), np.uint8).reshape(n, 32),

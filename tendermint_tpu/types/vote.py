@@ -223,24 +223,32 @@ class VoteSet:
         idx = vote.validator_index
         key = vote.block_id.key()
         existing = self._votes[idx]
-        if existing is not None:
-            # equivocation: track in its block's tally iff peer-claimed maj23
-            bv = self._votes_by_block.get(key)
-            if bv is None:
-                bv = _BlockVotes(self.size(), peer_maj23=False)
-                self._votes_by_block[key] = bv
-            if bv.peer_maj23:
-                bv.add_verified(idx, vote, power)
-                self._update_maj23(key, vote)
-            raise ErrVoteConflict(DuplicateVoteEvidence(existing, vote))
-        self._votes[idx] = vote
-        self._sum += power
+        conflict: ErrVoteConflict | None = None
+        if existing is None:
+            self._votes[idx] = vote
+            self._sum += power
+        else:
+            conflict = ErrVoteConflict(DuplicateVoteEvidence(existing, vote))
+            # if the conflicting vote is for the established maj23 block,
+            # promote it into the canonical array so make_commit always
+            # carries the full +2/3 (reference `types/vote_set.go:219-223`)
+            if self._maj23 is not None and self._maj23.key() == key:
+                self._votes[idx] = vote
         bv = self._votes_by_block.get(key)
         if bv is None:
+            if conflict is not None:
+                # conflicting vote for an untracked block: forget it rather
+                # than allocate — a byzantine validator signing many distinct
+                # hashes must not grow memory (reference vote_set.go:241-244)
+                raise conflict
             bv = _BlockVotes(self.size(), peer_maj23=False)
             self._votes_by_block[key] = bv
+        elif conflict is not None and not bv.peer_maj23:
+            raise conflict
         bv.add_verified(idx, vote, power)
         self._update_maj23(key, vote)
+        if conflict is not None:
+            raise conflict
         return True
 
     def _update_maj23(self, key: tuple, vote: Vote):
@@ -248,6 +256,12 @@ class VoteSet:
         if (self._maj23 is None and
                 bv.sum * 3 > self.val_set.total_voting_power() * 2):
             self._maj23 = vote.block_id
+            # copy this block's votes over the canonical array so conflicting
+            # votes that formed the majority are extractable by make_commit
+            # (reference `types/vote_set.go:267-271`)
+            for i, v in enumerate(bv.votes):
+                if v is not None:
+                    self._votes[i] = v
 
     def set_peer_maj23(self, peer_id: str, block_id) -> None:
         """A peer claims 2/3 for block_id: start counting conflicting votes

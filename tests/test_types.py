@@ -170,6 +170,90 @@ def test_voteset_conflict_evidence():
     assert vset.add_vote(v1) is False
 
 
+def _forge_vote(priv, vs, height, round_, type_, block_id):
+    """Sign with the raw key, bypassing the PrivValidator HRS guard —
+    byzantine behavior for conflict tests."""
+    idx = vs.index_of(priv.address)
+    v = Vote(validator_address=priv.address, validator_index=idx,
+             height=height, round=round_, type=type_, block_id=block_id)
+    return Vote(**{**v.__dict__,
+                   "signature": priv.priv_key.sign(v.sign_bytes(CHAIN))})
+
+
+def test_conflicting_votes_not_retained_for_untracked_blocks():
+    """Advisor regression: a byzantine validator signing many distinct block
+    hashes must not grow per-VoteSet memory (reference vote_set.go:241-244
+    forgets conflicting votes for untracked keys)."""
+    privs, vs = _valset(4)
+    vset = VoteSet(CHAIN, 1, 0, TYPE_PREVOTE, vs)
+    assert vset.add_vote(_vote(privs[0], vs, 1, 0, TYPE_PREVOTE,
+                               _block_id(b"aa")))
+    before = len(vset._votes_by_block)
+    for i in range(50):
+        spam = _forge_vote(privs[0], vs, 1, 0, TYPE_PREVOTE,
+                           _block_id(b"s%02d" % i))
+        with pytest.raises(ErrVoteConflict):
+            vset.add_vote(spam)
+    assert len(vset._votes_by_block) == before
+
+
+def test_peer_maj23_commit_carries_full_two_thirds():
+    """Advisor regression: when 2/3 forms partly from conflicting votes via
+    the peer_maj23 path, make_commit must still extract a commit whose
+    tallied power passes verify_commit (reference vote_set.go:219-223,267+)."""
+    privs, vs = _valset(4)  # power 10 each, quorum > 26
+    bid = _block_id(b"good")
+    other = _block_id(b"evil")
+    vset = VoteSet(CHAIN, 1, 0, TYPE_PRECOMMIT, vs)
+    # privs[0] first precommits a different block (its canonical vote)...
+    assert vset.add_vote(_vote(privs[0], vs, 1, 0, TYPE_PRECOMMIT, other))
+    vset.add_vote(_vote(privs[1], vs, 1, 0, TYPE_PRECOMMIT, bid))
+    vset.add_vote(_vote(privs[2], vs, 1, 0, TYPE_PRECOMMIT, bid))
+    assert vset.two_thirds_majority() is None      # 20/40 for bid
+    # ...a peer claims bid has 2/3, and privs[0]'s conflicting vote for bid
+    # arrives: it must count toward bid AND be extractable
+    vset.set_peer_maj23("peerA", bid)
+    dup = _forge_vote(privs[0], vs, 1, 0, TYPE_PRECOMMIT, bid)
+    with pytest.raises(ErrVoteConflict):
+        vset.add_vote(dup)
+    maj = vset.two_thirds_majority()
+    assert maj is not None and maj.key() == bid.key()
+    commit = vset.make_commit()
+    vs.verify_commit(CHAIN, bid, 1, commit)        # full +2/3 present
+
+
+def test_proof_short_aunts_returns_false():
+    """Advisor regression: a proof with fewer aunts than the path depth must
+    fail verification cleanly, not raise IndexError."""
+    from tendermint_tpu.types.merkle import Proof, proofs
+    rt, prs = proofs([b"a", b"b", b"c", b"d"])
+    p = prs[2]
+    truncated = Proof(p.total, p.index, p.leaf, p.aunts[:1])
+    assert truncated.verify(rt) is False
+    assert Proof(p.total, p.index, p.leaf, ()).verify(rt) is False
+
+
+def test_verify_commit_rejects_bad_sig_on_other_block_precommit():
+    """Advisor regression: a commit carrying a garbage signature on a
+    precommit for a DIFFERENT block must be rejected, matching the
+    reference's VerifyCommit which checks every non-nil signature."""
+    privs, vs = _valset(4)
+    bid = _block_id()
+    vset = VoteSet(CHAIN, 5, 0, TYPE_PRECOMMIT, vs)
+    for p in privs[:3]:
+        vset.add_vote(_vote(p, vs, 5, 0, TYPE_PRECOMMIT, bid))
+    commit = vset.make_commit()
+    # splice in a non-tallied precommit for another block with a forged sig
+    other = _block_id(b"zz")
+    idx = vs.index_of(privs[3].address)
+    garbage = Vote(validator_address=privs[3].address, validator_index=idx,
+                   height=5, round=0, type=TYPE_PRECOMMIT, block_id=other,
+                   signature=b"\x09" * 64)
+    commit.precommits[idx] = garbage
+    with pytest.raises(ValueError, match="signature"):
+        vs.verify_commit(CHAIN, bid, 5, commit)
+
+
 def test_malformed_votes_cannot_poison_batches():
     """Regression: wire-decoded votes with non-standard hash/sig lengths
     must be rejected individually, never crash or misalign batch lanes."""
