@@ -37,6 +37,10 @@ from tendermint_tpu.types.events import EventCache, EventSwitch
 from tendermint_tpu.types.priv_validator import DoubleSignError
 from tendermint_tpu.types.vote import ErrVoteConflict
 from tendermint_tpu.utils.fail import fail_point
+from tendermint_tpu.utils.log import get_logger
+from tendermint_tpu.utils.metrics import REGISTRY
+
+log = get_logger("consensus")
 
 # round steps (reference consensus/state.go:47-57)
 STEP_NEW_HEIGHT = 1
@@ -214,8 +218,11 @@ class ConsensusState:
                                 self.wal.save_message(M.encode_msg(msg))
                         self._handle_msg(msg, peer_id)
             except Exception:
-                import traceback
-                traceback.print_exc()
+                # the receive loop must never die; reference recovers the
+                # same way and relies on WAL replay for true corruption
+                log.exception("error handling consensus input",
+                              height=self.height, round=self.round,
+                              step=STEP_NAMES.get(self.step, self.step))
 
     def _on_timeout_fire(self, ti: TimeoutInfo) -> None:
         self._queue.put(ti)
@@ -327,6 +334,9 @@ class ConsensusState:
             self.validators = validators
         self.round = round_
         self.step = STEP_NEW_ROUND
+        REGISTRY.rounds_started.inc()
+        log.debug("enter new round", height=height, round=round_,
+                  proposer=self.validators.proposer.address)
         if round_ != 0:
             # new round: drop the previous round's proposal
             self.proposal = None
@@ -565,6 +575,11 @@ class ConsensusState:
         fail_point("consensus.finalizeCommit.applied")
         event_cache.fire(ev.NEW_BLOCK, block)
         event_cache.fire(ev.NEW_BLOCK_HEADER, block.header)
+        REGISTRY.blocks_committed.inc()
+        REGISTRY.txs_committed.inc(len(block.txs))
+        log.info("committed block", height=block.height,
+                 hash=block.hash(), txs=len(block.txs),
+                 app_hash=state_copy.app_hash)
         self._update_to_state(state_copy)
         event_cache.flush()
         self._schedule_round_0()
@@ -739,7 +754,6 @@ class ConsensusState:
                         h, r, s = struct.unpack(">QIB", payload)
                         self._handle_timeout(TimeoutInfo(h, r, s))
                 except Exception:
-                    import traceback
-                    traceback.print_exc()
+                    log.exception("error replaying WAL record")
         finally:
             self._replay_mode = False

@@ -16,11 +16,13 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from typing import Protocol
 
 import numpy as np
 
 from tendermint_tpu.crypto import pure_ed25519 as _ref
+from tendermint_tpu.utils.metrics import REGISTRY
 
 MIN_BUCKET = 16
 
@@ -51,6 +53,8 @@ class PythonBackend:
         for i in range(len(pubkeys)):
             out[i] = _ref.verify(pubkeys[i].tobytes(), msgs[i].tobytes(),
                                  sigs[i].tobytes())
+        REGISTRY.sigs_requested.inc(len(pubkeys))
+        REGISTRY.sigs_verified.inc(len(pubkeys))
         return out
 
 
@@ -81,9 +85,16 @@ class TpuBackend:
             msgs = np.concatenate([msgs, np.repeat(msgs[:1], pad, 0)])
             sigs = np.concatenate([sigs, np.repeat(sigs[:1], pad, 0)])
         jnp = self._jnp
+        t0 = time.perf_counter()
         out = self._dev.verify_batch(jnp.asarray(pubkeys), jnp.asarray(msgs),
                                      jnp.asarray(sigs))
-        return np.asarray(out)[:n]
+        out = np.asarray(out)
+        REGISTRY.device_step_seconds.observe(time.perf_counter() - t0)
+        REGISTRY.sigs_requested.inc(n)
+        REGISTRY.sigs_verified.inc(b)
+        REGISTRY.verify_batches.inc()
+        REGISTRY.batch_occupancy.observe(n / b)
+        return out[:n]
 
 
 _cache_enabled = False

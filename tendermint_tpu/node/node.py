@@ -23,6 +23,10 @@ from tendermint_tpu.state.txindex import KVTxIndexer, NullTxIndexer
 from tendermint_tpu.types import GenesisDoc, PrivValidator
 from tendermint_tpu.types.events import EventSwitch
 from tendermint_tpu.utils.db import new_db
+from tendermint_tpu.utils import log as log_mod
+from tendermint_tpu.utils import metrics
+
+log = log_mod.get_logger("node")
 
 
 class Node:
@@ -37,6 +41,7 @@ class Node:
         """
         self.config = config
         base = config.base
+        log_mod.set_level_spec(base.log_level)
         crypto_backend.set_backend(base.crypto_backend)
 
         # --- storage (reference :70-77) ---
@@ -104,10 +109,8 @@ class Node:
         try:
             from tendermint_tpu.node.p2p_setup import build_p2p
         except ImportError:
-            import sys
-            print("WARNING: p2p.laddr is set but the p2p stack is "
-                  "unavailable; running solo with no networking",
-                  file=sys.stderr)
+            log.warn("p2p.laddr is set but the p2p stack is unavailable; "
+                     "running solo with no networking")
             return
         self.switch = build_p2p(self)
 
@@ -158,4 +161,5 @@ class Node:
             "latest_app_hash": self.state.app_hash.hex(),
             "validator_count": self.state.validators.size(),
             "consensus": self.consensus.get_round_state_summary(),
+            "metrics": metrics.snapshot(),
         }

@@ -1,0 +1,127 @@
+"""First-class runtime metrics — "the benchmark currency" (SURVEY.md §5).
+
+The reference's observability is events + RPC snapshots; this framework
+additionally counts the quantities its design is judged on: blocks
+committed/s, signatures verified/s, verify-batch occupancy (how full the
+padded device batches run), and device step latency.
+
+Global registry, lock-per-instrument, exposed as one dict via
+`snapshot()` for the `status` / `dump_consensus_state` RPC routes and for
+bench harnesses.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class Counter:
+    __slots__ = ("_v", "_lock")
+
+    def __init__(self):
+        self._v = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._v += n
+
+    @property
+    def value(self) -> int:
+        return self._v
+
+
+class Gauge:
+    __slots__ = ("_v", "_lock")
+
+    def __init__(self):
+        self._v = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._v = v
+
+    @property
+    def value(self) -> float:
+        return self._v
+
+
+class Summary:
+    """Streaming mean/max with exponential decay toward recent samples."""
+    __slots__ = ("_mean", "_max", "_n", "_lock", "alpha")
+
+    def __init__(self, alpha: float = 0.1):
+        self._mean = 0.0
+        self._max = 0.0
+        self._n = 0
+        self._lock = threading.Lock()
+        self.alpha = alpha
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            self._n += 1
+            if self._n == 1:
+                self._mean = v
+            else:
+                self._mean += self.alpha * (v - self._mean)
+            if v > self._max:
+                self._max = v
+
+    @property
+    def mean(self) -> float:
+        return self._mean
+
+    @property
+    def count(self) -> int:
+        return self._n
+
+
+class Registry:
+    def __init__(self):
+        self._start = time.time()
+        # consensus plane
+        self.blocks_committed = Counter()
+        self.txs_committed = Counter()
+        self.rounds_started = Counter()
+        # crypto plane
+        self.sigs_verified = Counter()        # lanes checked (incl. padding)
+        self.sigs_requested = Counter()       # real signatures asked for
+        self.verify_batches = Counter()
+        self.batch_occupancy = Summary()      # real/padded per batch
+        self.device_step_seconds = Summary()  # wall time per device call
+        # sync plane
+        self.blocks_synced = Counter()
+        # p2p plane
+        self.peers = Gauge()
+        self.msgs_sent = Counter()
+        self.msgs_received = Counter()
+
+    def snapshot(self) -> dict:
+        up = max(time.time() - self._start, 1e-9)
+        return {
+            "uptime_seconds": round(up, 1),
+            "blocks_committed": self.blocks_committed.value,
+            "blocks_per_sec": round(self.blocks_committed.value / up, 3),
+            "txs_committed": self.txs_committed.value,
+            "rounds_started": self.rounds_started.value,
+            "sigs_requested": self.sigs_requested.value,
+            "sigs_verified_lanes": self.sigs_verified.value,
+            "sigs_per_sec": round(self.sigs_requested.value / up, 1),
+            "verify_batches": self.verify_batches.value,
+            "batch_occupancy_mean": round(self.batch_occupancy.mean, 4),
+            "device_step_seconds_mean":
+                round(self.device_step_seconds.mean, 6),
+            "blocks_synced": self.blocks_synced.value,
+            "peers": self.peers.value,
+            "p2p_msgs_sent": self.msgs_sent.value,
+            "p2p_msgs_received": self.msgs_received.value,
+        }
+
+
+REGISTRY = Registry()
+
+
+def snapshot() -> dict:
+    return REGISTRY.snapshot()

@@ -1,0 +1,84 @@
+"""Logging + metrics subsystem tests (SURVEY.md §5 observability)."""
+
+from tendermint_tpu.utils import log as log_mod
+from tendermint_tpu.utils import metrics
+
+
+def test_level_spec_filtering():
+    lines = []
+    log_mod.set_sink(lines.append)
+    try:
+        log_mod.set_level_spec("consensus:debug,*:error")
+        cons = log_mod.get_logger("consensus")
+        p2p = log_mod.get_logger("p2p")
+        cons.debug("visible", height=5)
+        p2p.info("hidden")
+        p2p.error("boom", peer="abc")
+        assert len(lines) == 2
+        assert "visible" in lines[0] and "height=5" in lines[0]
+        assert "boom" in lines[1] and "peer=abc" in lines[1]
+    finally:
+        log_mod.set_sink(None)
+        log_mod.set_level_spec("info")
+
+
+def test_bound_context_and_bytes_formatting():
+    lines = []
+    log_mod.set_sink(lines.append)
+    try:
+        log_mod.set_level_spec("info")
+        lg = log_mod.get_logger("x").with_(peer=b"\xab\xcd" * 12)
+        lg.info("msg", val=1.23456789)
+        assert "peer=abcdabcdabcdabcd" in lines[0]   # truncated hex
+        assert "val=1.235" in lines[0]
+    finally:
+        log_mod.set_sink(None)
+
+
+def test_exception_logging_has_traceback():
+    lines = []
+    log_mod.set_sink(lines.append)
+    try:
+        log_mod.set_level_spec("info")
+        try:
+            raise ValueError("inner detail")
+        except ValueError:
+            log_mod.get_logger("x").exception("caught")
+        joined = "\n".join(lines)
+        assert "caught" in joined and "inner detail" in joined
+    finally:
+        log_mod.set_sink(None)
+
+
+def test_metrics_counters_and_occupancy():
+    r = metrics.Registry()
+    r.blocks_committed.inc()
+    r.txs_committed.inc(7)
+    r.batch_occupancy.observe(0.5)
+    r.batch_occupancy.observe(1.0)
+    snap = r.snapshot()
+    assert snap["blocks_committed"] == 1
+    assert snap["txs_committed"] == 7
+    assert 0.5 <= snap["batch_occupancy_mean"] <= 1.0
+    assert snap["blocks_per_sec"] > 0
+
+
+def test_backend_updates_global_metrics():
+    import numpy as np
+    from tendermint_tpu.crypto import backend as cb
+    from tendermint_tpu.crypto import pure_ed25519 as ref
+    seed = b"\x01" * 32
+    msg = b"m" * 64
+    pub, sig = ref.pubkey_from_seed(seed), ref.sign(seed, msg)
+    before = metrics.REGISTRY.sigs_requested.value
+    old = cb._current
+    cb.set_backend("python")
+    try:
+        ok = cb.verify_batch(
+            np.frombuffer(pub, np.uint8).reshape(1, 32),
+            np.frombuffer(msg, np.uint8).reshape(1, 64),
+            np.frombuffer(sig, np.uint8).reshape(1, 64))
+        assert ok.all()
+    finally:
+        cb._current = old
+    assert metrics.REGISTRY.sigs_requested.value == before + 1
