@@ -1,0 +1,29 @@
+"""P2P communication backend.
+
+Reference: `p2p/` (5,909 LoC Go) — Switch + MConnection + SecretConnection
++ Peer + PEX/AddrBook + fuzzing.  See the per-module docstrings for the
+reference mapping; `switch.make_connected_switches` is the in-process
+multi-node harness the test suite uses (reference
+`p2p/switch.go:495-543`).
+"""
+
+from tendermint_tpu.p2p.addrbook import AddrBook
+from tendermint_tpu.p2p.connection import MConnection
+from tendermint_tpu.p2p.fuzz import FuzzedConnection
+from tendermint_tpu.p2p.peer import Peer, Reactor
+from tendermint_tpu.p2p.pex import PEXReactor, PEX_CHANNEL
+from tendermint_tpu.p2p.secret import SecretConnection
+from tendermint_tpu.p2p.switch import (Switch, SwitchError,
+                                       connect_switches, make_switch,
+                                       make_connected_switches)
+from tendermint_tpu.p2p.transport import (Listener, StreamConn, dial,
+                                          mem_pair)
+from tendermint_tpu.p2p.types import ChannelDescriptor, NetAddress, NodeInfo
+
+__all__ = [
+    "AddrBook", "MConnection", "FuzzedConnection", "Peer", "Reactor",
+    "PEXReactor", "PEX_CHANNEL", "SecretConnection", "Switch",
+    "SwitchError", "connect_switches", "make_switch",
+    "make_connected_switches", "Listener", "StreamConn", "dial",
+    "mem_pair", "ChannelDescriptor", "NetAddress", "NodeInfo",
+]

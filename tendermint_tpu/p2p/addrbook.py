@@ -1,0 +1,167 @@
+"""AddrBook: known-peer address book with new/old buckets.
+
+Reference: `p2p/addrbook.go:21-60` (btcd-derived) — addresses live in
+hashed buckets (256 "new" for unvetted, 64 "old" for proven), eviction is
+randomized within a full bucket, the book persists to JSON periodically
+and on close.  This implementation keeps the bucket structure and
+good/bad promotion semantics at a fraction of the size.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import random
+import threading
+import time
+
+from tendermint_tpu.p2p.types import NetAddress
+
+NEW_BUCKETS = 256
+OLD_BUCKETS = 64
+BUCKET_SIZE = 64
+
+
+class _Entry:
+    __slots__ = ("addr", "src", "attempts", "last_attempt", "last_success",
+                 "old")
+
+    def __init__(self, addr: NetAddress, src: str):
+        self.addr = addr
+        self.src = src
+        self.attempts = 0
+        self.last_attempt = 0.0
+        self.last_success = 0.0
+        self.old = False
+
+    def to_json(self) -> dict:
+        return {"addr": str(self.addr), "src": self.src,
+                "attempts": self.attempts, "old": self.old,
+                "last_success": self.last_success}
+
+    @classmethod
+    def from_json(cls, d: dict) -> "_Entry":
+        e = cls(NetAddress.parse(d["addr"]), d.get("src", ""))
+        e.attempts = int(d.get("attempts", 0))
+        e.old = bool(d.get("old", False))
+        e.last_success = float(d.get("last_success", 0.0))
+        return e
+
+
+class AddrBook:
+    def __init__(self, path: str = "", our_addrs: set[str] | None = None):
+        self.path = path
+        self._entries: dict[str, _Entry] = {}     # key: host:port
+        self._our = our_addrs or set()
+        self._lock = threading.Lock()
+        self._rng = random.Random()
+        if path and os.path.exists(path):
+            self._load()
+
+    # -- bucket math (structure parity; buckets are implicit partitions) --
+    @staticmethod
+    def _bucket_of(key: str, old: bool) -> int:
+        h = hashlib.sha256(key.encode()).digest()
+        return h[0] % (OLD_BUCKETS if old else NEW_BUCKETS)
+
+    def _bucket_members(self, bucket: int, old: bool) -> list[_Entry]:
+        return [e for k, e in self._entries.items()
+                if e.old == old and self._bucket_of(k, old) == bucket]
+
+    # -- mutation -------------------------------------------------------
+    def add_address(self, addr: NetAddress, src: str = "") -> bool:
+        key = addr.dial_string()
+        if key in self._our or not addr.port:
+            return False
+        with self._lock:
+            if key in self._entries:
+                return False
+            e = _Entry(addr, src)
+            bucket = self._bucket_of(key, old=False)
+            members = self._bucket_members(bucket, old=False)
+            if len(members) >= BUCKET_SIZE:
+                # randomized eviction of an unvetted address
+                evict = self._rng.choice(members)
+                self._entries.pop(evict.addr.dial_string(), None)
+            self._entries[key] = e
+            return True
+
+    def mark_attempt(self, addr: NetAddress) -> None:
+        with self._lock:
+            e = self._entries.get(addr.dial_string())
+            if e is not None:
+                e.attempts += 1
+                e.last_attempt = time.time()
+
+    def mark_good(self, addr: NetAddress) -> None:
+        """Promote to an old bucket (proven peer)."""
+        with self._lock:
+            e = self._entries.get(addr.dial_string())
+            if e is None:
+                e = _Entry(addr, "")
+                self._entries[addr.dial_string()] = e
+            e.attempts = 0
+            e.last_success = time.time()
+            if not e.old:
+                bucket = self._bucket_of(addr.dial_string(), old=True)
+                members = self._bucket_members(bucket, old=True)
+                if len(members) >= BUCKET_SIZE:
+                    demote = self._rng.choice(members)
+                    demote.old = False
+                e.old = True
+
+    def mark_bad(self, addr: NetAddress) -> None:
+        with self._lock:
+            self._entries.pop(addr.dial_string(), None)
+
+    # -- selection ------------------------------------------------------
+    def pick_address(self, new_bias: float = 0.5) -> NetAddress | None:
+        """Random address, biased between new/old pools
+        (reference PickAddress bias parameter)."""
+        with self._lock:
+            news = [e for e in self._entries.values() if not e.old]
+            olds = [e for e in self._entries.values() if e.old]
+            pool = None
+            if news and (not olds or self._rng.random() < new_bias):
+                pool = news
+            elif olds:
+                pool = olds
+            if not pool:
+                return None
+            return self._rng.choice(pool).addr
+
+    def sample(self, n: int = 10) -> list[NetAddress]:
+        with self._lock:
+            entries = list(self._entries.values())
+        self._rng.shuffle(entries)
+        return [e.addr for e in entries[:n]]
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def has(self, addr: NetAddress) -> bool:
+        with self._lock:
+            return addr.dial_string() in self._entries
+
+    # -- persistence ----------------------------------------------------
+    def save(self) -> None:
+        if not self.path:
+            return
+        with self._lock:
+            data = [e.to_json() for e in self._entries.values()]
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"addrs": data}, f)
+        os.replace(tmp, self.path)
+
+    def _load(self) -> None:
+        try:
+            with open(self.path) as f:
+                data = json.load(f)
+            for d in data.get("addrs", []):
+                e = _Entry.from_json(d)
+                self._entries[e.addr.dial_string()] = e
+        except (OSError, ValueError, KeyError):
+            pass                         # corrupt book: start fresh

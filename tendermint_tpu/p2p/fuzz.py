@@ -1,0 +1,55 @@
+"""Fault-injection connection wrapper for lossy-network tests.
+
+Reference: `p2p/fuzz.go:10-60` — FuzzedConnection randomly drops or
+delays reads/writes.  Wraps any conn exposing read_exact/write/close.
+
+Dropping a *write* silently discards a whole MConnection packet; the
+framing layer tolerates this the same way it tolerates a lossy network —
+messages straddling the gap fail reassembly and the peer is dropped, or
+(for idempotent gossip) the protocol retransmits.  Delay injects jitter.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+
+
+class FuzzedConnection:
+    def __init__(self, conn, drop_prob: float = 0.0,
+                 delay_prob: float = 0.0, max_delay: float = 0.05,
+                 seed: int | None = None):
+        self._conn = conn
+        self.drop_prob = drop_prob
+        self.delay_prob = delay_prob
+        self.max_delay = max_delay
+        self._rng = random.Random(seed)
+
+    def _fuzz(self) -> bool:
+        """Returns True if the operation should be dropped."""
+        r = self._rng.random()
+        if r < self.drop_prob:
+            return True
+        if r < self.drop_prob + self.delay_prob:
+            time.sleep(self._rng.random() * self.max_delay)
+        return False
+
+    def write(self, data: bytes) -> None:
+        if self._fuzz():
+            return                      # dropped on the floor
+        self._conn.write(data)
+
+    def read_exact(self, n: int) -> bytes:
+        self._fuzz()                    # reads only delay, never drop:
+        return self._conn.read_exact(n)  # dropping reads would desync framing
+
+    def close(self) -> None:
+        self._conn.close()
+
+    @property
+    def closed(self) -> bool:
+        return self._conn.closed
+
+    @property
+    def label(self) -> str:
+        return getattr(self._conn, "label", "")
