@@ -1,0 +1,534 @@
+"""Consensus gossip reactor: parts/votes/maj23 dissemination over p2p.
+
+Reference: `consensus/reactor.go` (1353 LoC) — four p2p channels (State
+0x20, Data 0x21, Vote 0x22, VoteSetBits 0x23, `:20-27,93-120`); per-peer
+gossip routines spawned in `AddPeer` (`:123-142`): `gossipDataRoutine`
+(`:413`) pushes proposals/POL/block parts the peer is missing,
+`gossipVotesRoutine` (`:537`) pushes votes chosen against the peer's
+bit-arrays, `queryMaj23Routine` (`:647`) advertises two-thirds
+majorities; `Receive` demuxes inbound (`:159-302`); `PeerState` mirrors
+each peer's round progress (`:757-1168`).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+from tendermint_tpu.consensus import messages as M
+from tendermint_tpu.consensus.state import (STEP_NEW_HEIGHT,
+                                            STEP_PRECOMMIT_WAIT,
+                                            STEP_PREVOTE)
+from tendermint_tpu.p2p.peer import Peer, Reactor
+from tendermint_tpu.p2p.types import ChannelDescriptor
+from tendermint_tpu.types import TYPE_PRECOMMIT, TYPE_PREVOTE
+from tendermint_tpu.utils.log import get_logger
+
+log = get_logger("cons-rx")
+
+STATE_CHANNEL = 0x20
+DATA_CHANNEL = 0x21
+VOTE_CHANNEL = 0x22
+VOTE_SET_BITS_CHANNEL = 0x23
+
+GOSSIP_SLEEP = 0.02          # reference peerGossipSleepDuration (100ms)
+MAJ23_SLEEP = 0.5            # reference peerQueryMaj23SleepDuration (2s)
+
+
+class PeerRoundState:
+    """Mirror of one peer's consensus progress
+    (reference `consensus/reactor.go:1068+` PeerRoundState)."""
+
+    def __init__(self):
+        self.height = 0
+        self.round = -1
+        self.step = 0
+        self.proposal = False
+        self.proposal_block_parts_header = None
+        self.proposal_block_parts: list[bool] | None = None
+        self.proposal_pol_round = -1
+        self.proposal_pol: list[bool] | None = None
+        self.prevotes: dict[int, list[bool]] = {}       # round -> bits
+        self.precommits: dict[int, list[bool]] = {}
+        self.last_commit_round = -1
+        self.last_commit: list[bool] | None = None
+        self.catchup_commit_round = -1
+        self.catchup_commit: list[bool] | None = None
+
+
+class PeerState:
+    """Thread-safe wrapper around PeerRoundState
+    (reference `consensus/reactor.go:757-1168`)."""
+
+    def __init__(self, peer: Peer):
+        self.peer = peer
+        self.prs = PeerRoundState()
+        self._lock = threading.RLock()
+
+    # -- applying peer messages ----------------------------------------
+    def apply_new_round_step(self, msg: M.NewRoundStepMessage) -> None:
+        with self._lock:
+            prs = self.prs
+            ph, pr = prs.height, prs.round
+            prs.height, prs.round, prs.step = msg.height, msg.round, msg.step
+            if ph != msg.height or pr != msg.round:
+                prs.proposal = False
+                prs.proposal_block_parts_header = None
+                prs.proposal_block_parts = None
+                prs.proposal_pol_round = -1
+                prs.proposal_pol = None
+            if ph == msg.height and pr != msg.round and \
+                    msg.round == prs.catchup_commit_round:
+                prs.precommits[msg.round] = prs.catchup_commit or []
+            if ph != msg.height:
+                # peer advanced: its current-round precommits become the
+                # last-commit view (reference :1232-1245)
+                if ph + 1 == msg.height and pr == msg.last_commit_round:
+                    prs.last_commit_round = msg.last_commit_round
+                    prs.last_commit = prs.precommits.get(pr)
+                else:
+                    prs.last_commit_round = msg.last_commit_round
+                    prs.last_commit = None
+                prs.prevotes.clear()
+                prs.precommits.clear()
+                prs.catchup_commit_round = -1
+                prs.catchup_commit = None
+
+    def apply_commit_step(self, msg: M.CommitStepMessage) -> None:
+        with self._lock:
+            if self.prs.height != msg.height:
+                return
+            if self.prs.proposal_block_parts is not None and \
+                    len(msg.parts_bits) == len(self.prs.proposal_block_parts):
+                self.prs.proposal_block_parts = list(msg.parts_bits)
+
+    def set_has_proposal(self, proposal) -> None:
+        with self._lock:
+            prs = self.prs
+            if prs.height != proposal.height or prs.round != proposal.round \
+                    or prs.proposal:
+                return
+            prs.proposal = True
+            prs.proposal_block_parts_header = proposal.block_parts_header
+            if prs.proposal_block_parts is None:
+                prs.proposal_block_parts = \
+                    [False] * proposal.block_parts_header.total
+            prs.proposal_pol_round = proposal.pol_round
+            prs.proposal_pol = None
+
+    def init_proposal_block_parts(self, header) -> None:
+        """Sender-side init for catchup gossip (reference
+        `InitProposalBlockParts` — the committed block at the peer's
+        height is unique, so assume its header)."""
+        with self._lock:
+            if self.prs.proposal_block_parts is None:
+                self.prs.proposal_block_parts_header = header
+                self.prs.proposal_block_parts = [False] * header.total
+
+    def set_has_part(self, height: int, index: int) -> None:
+        with self._lock:
+            prs = self.prs
+            if prs.height != height or prs.proposal_block_parts is None:
+                return
+            if 0 <= index < len(prs.proposal_block_parts):
+                prs.proposal_block_parts[index] = True
+
+    def apply_proposal_pol(self, msg: M.ProposalPOLMessage) -> None:
+        with self._lock:
+            prs = self.prs
+            if prs.height != msg.height or \
+                    prs.proposal_pol_round != msg.proposal_pol_round:
+                return
+            prs.proposal_pol = list(msg.proposal_pol)
+
+    def _bits_for(self, height: int, round_: int, type_: int,
+                  n: int | None = None) -> list[bool] | None:
+        """The peer's vote bit-array for (height, round, type), creating it
+        when `n` (validator count) is given (reference getVoteBitArray)."""
+        prs = self.prs
+        if height == prs.height:
+            d = prs.prevotes if type_ == TYPE_PREVOTE else prs.precommits
+            bits = d.get(round_)
+            if bits is None and n is not None:
+                bits = d[round_] = [False] * n
+            if bits is None and type_ == TYPE_PRECOMMIT and \
+                    round_ == prs.catchup_commit_round:
+                return prs.catchup_commit
+            return bits
+        if height + 1 == prs.height and type_ == TYPE_PRECOMMIT and \
+                round_ == prs.last_commit_round:
+            if prs.last_commit is None and n is not None:
+                prs.last_commit = [False] * n
+            return prs.last_commit
+        if height < prs.height - 1 and type_ == TYPE_PRECOMMIT:
+            return None
+        return None
+
+    def ensure_catchup_commit(self, height: int, round_: int, n: int) -> None:
+        with self._lock:
+            prs = self.prs
+            if prs.height == height and prs.catchup_commit_round != round_:
+                prs.catchup_commit_round = round_
+                prs.catchup_commit = [False] * n
+
+    def set_has_vote(self, height: int, round_: int, type_: int,
+                     index: int, n: int | None = None) -> None:
+        with self._lock:
+            prs = self.prs
+            if height == prs.height and prs.catchup_commit_round == round_ \
+                    and type_ == TYPE_PRECOMMIT and \
+                    prs.catchup_commit is not None and \
+                    index < len(prs.catchup_commit):
+                prs.catchup_commit[index] = True
+            bits = self._bits_for(height, round_, type_, n)
+            if bits is not None and 0 <= index < len(bits):
+                bits[index] = True
+
+    def apply_vote_set_bits(self, msg: M.VoteSetBitsMessage,
+                            our_bits: list[bool] | None) -> None:
+        """Merge a peer's claimed vote bits.  When the claim is for a
+        specific block we AND with our own view per the reference's
+        sub-set semantics (`ApplyVoteSetBitsMessage`)."""
+        with self._lock:
+            bits = self._bits_for(msg.height, msg.round, msg.type,
+                                  len(msg.votes_bits))
+            if bits is None:
+                return
+            for i, b in enumerate(msg.votes_bits):
+                if i < len(bits) and b:
+                    bits[i] = True
+
+    def pick_missing(self, ours: list[bool],
+                     theirs: list[bool] | None) -> int | None:
+        """Random index we have and the peer lacks."""
+        with self._lock:
+            if theirs is None:
+                theirs = []
+            cands = [i for i, o in enumerate(ours)
+                     if o and (i >= len(theirs) or not theirs[i])]
+        return random.choice(cands) if cands else None
+
+
+class ConsensusReactor(Reactor):
+    """Reference `consensus/reactor.go:38-302`."""
+
+    def __init__(self, consensus_state, fast_sync: bool = False,
+                 gossip_sleep: float = GOSSIP_SLEEP):
+        super().__init__()
+        self.cs = consensus_state
+        self.fast_sync = fast_sync
+        self.gossip_sleep = gossip_sleep
+        self._peer_stops: dict[str, threading.Event] = {}
+        self._lock = threading.Lock()
+        # core -> network: NewRoundStep/HasVote broadcasts
+        # (reference `registerEventCallbacks` :321-382)
+        self.cs.broadcast_cb = self._on_core_broadcast
+
+    def get_channels(self):
+        return [
+            ChannelDescriptor(id=STATE_CHANNEL, priority=5,
+                              send_queue_capacity=100),
+            ChannelDescriptor(id=DATA_CHANNEL, priority=10,
+                              send_queue_capacity=100),
+            ChannelDescriptor(id=VOTE_CHANNEL, priority=5,
+                              send_queue_capacity=100),
+            ChannelDescriptor(id=VOTE_SET_BITS_CHANNEL, priority=1,
+                              send_queue_capacity=2),
+        ]
+
+    def start(self) -> None:
+        if not self.fast_sync:
+            self.cs.start()
+
+    def stop(self) -> None:
+        with self._lock:
+            for ev in self._peer_stops.values():
+                ev.set()
+        self.cs.stop()
+
+    def switch_to_consensus(self, state) -> None:
+        """Fast-sync is caught up: boot the live state machine
+        (reference `SwitchToConsensus` :78-90)."""
+        self.fast_sync = False
+        self.cs._update_to_state(state)
+        self.cs._reconstruct_last_commit(state)
+        self.cs.start()
+
+    # -- core -> network -----------------------------------------------
+    def _on_core_broadcast(self, msg) -> None:
+        if isinstance(msg, (M.NewRoundStepMessage, M.HasVoteMessage)):
+            if self.switch is not None:
+                self.switch.broadcast(STATE_CHANNEL, M.encode_msg(msg))
+        # proposals/parts/votes flow through the per-peer gossip routines
+
+    # -- peer lifecycle -------------------------------------------------
+    def add_peer(self, peer: Peer) -> None:
+        ps = PeerState(peer)
+        peer.set("consensus", ps)
+        stop = threading.Event()
+        with self._lock:
+            self._peer_stops[peer.id] = stop
+        for fn, name in ((self._gossip_data_routine, "gossip-data"),
+                         (self._gossip_votes_routine, "gossip-votes"),
+                         (self._query_maj23_routine, "query-maj23")):
+            threading.Thread(target=fn, args=(peer, ps, stop), daemon=True,
+                             name=f"{name}-{peer.id[:8]}").start()
+        # tell the new peer where we are
+        rs = self.cs.get_round_state()
+        lcr = rs.last_commit.round if rs.last_commit else -1
+        peer.try_send(STATE_CHANNEL, M.encode_msg(M.NewRoundStepMessage(
+            height=rs.height, round=rs.round, step=rs.step,
+            seconds_since_start=max(0, int(time.time() - rs.start_time)),
+            last_commit_round=lcr)))
+
+    def remove_peer(self, peer: Peer, reason) -> None:
+        with self._lock:
+            stop = self._peer_stops.pop(peer.id, None)
+        if stop is not None:
+            stop.set()
+
+    # -- inbound demux (reference :159-302) ------------------------------
+    def receive(self, ch_id: int, peer: Peer, raw: bytes) -> None:
+        try:
+            msg = M.decode_msg(raw)
+        except (ValueError, IndexError) as e:
+            self.switch.stop_peer_for_error(peer, f"bad consensus msg: {e}")
+            return
+        ps: PeerState = peer.get("consensus")
+        if ps is None:
+            return
+        if ch_id == STATE_CHANNEL:
+            if isinstance(msg, M.NewRoundStepMessage):
+                ps.apply_new_round_step(msg)
+            elif isinstance(msg, M.CommitStepMessage):
+                ps.apply_commit_step(msg)
+            elif isinstance(msg, M.HasVoteMessage):
+                ps.set_has_vote(msg.height, msg.round, msg.type, msg.index)
+            elif isinstance(msg, M.VoteSetMaj23Message):
+                self._on_vote_set_maj23(peer, ps, msg)
+        elif ch_id == DATA_CHANNEL:
+            if self.fast_sync:
+                return
+            if isinstance(msg, M.ProposalMessage):
+                ps.set_has_proposal(msg.proposal)
+                self.cs.set_proposal(msg.proposal, peer.id)
+            elif isinstance(msg, M.ProposalPOLMessage):
+                ps.apply_proposal_pol(msg)
+            elif isinstance(msg, M.BlockPartMessage):
+                ps.set_has_part(msg.height, msg.part.index)
+                self.cs.add_proposal_block_part(msg.height, msg.round,
+                                                msg.part, peer.id)
+        elif ch_id == VOTE_CHANNEL:
+            if self.fast_sync:
+                return
+            if isinstance(msg, M.VoteMessage):
+                v = msg.vote
+                rs = self.cs.get_round_state()
+                n = rs.validators.size() if rs.validators else None
+                ps.set_has_vote(v.height, v.round, v.type,
+                                v.validator_index, n)
+                self.cs.add_vote(v, peer.id)
+        elif ch_id == VOTE_SET_BITS_CHANNEL:
+            if isinstance(msg, M.VoteSetBitsMessage):
+                ps.apply_vote_set_bits(msg, None)
+
+    def _on_vote_set_maj23(self, peer: Peer, ps: PeerState,
+                           msg: M.VoteSetMaj23Message) -> None:
+        """Track the claim and answer with our bits for that block
+        (reference :216-249)."""
+        try:
+            self.cs.set_peer_maj23(msg.height, msg.round, msg.type,
+                                   peer.id, msg.block_id)
+        except ValueError as e:
+            self.switch.stop_peer_for_error(peer, f"bad maj23: {e}")
+            return
+        rs = self.cs.get_round_state()
+        if rs.height != msg.height or rs.votes is None:
+            return
+        vs = (rs.votes.prevotes(msg.round) if msg.type == TYPE_PREVOTE
+              else rs.votes.precommits(msg.round))
+        if vs is None:
+            return
+        peer.try_send(VOTE_SET_BITS_CHANNEL, M.encode_msg(
+            M.VoteSetBitsMessage(
+                height=msg.height, round=msg.round, type=msg.type,
+                block_id=msg.block_id,
+                votes_bits=tuple(vs.bit_array_by_block_id(msg.block_id)))))
+
+    # -- gossip routines -------------------------------------------------
+    def _gossip_data_routine(self, peer: Peer, ps: PeerState,
+                             stop: threading.Event) -> None:
+        """Reference `gossipDataRoutine` :413-491."""
+        while not stop.is_set():
+            try:
+                if not self._gossip_data_once(peer, ps):
+                    time.sleep(self.gossip_sleep)
+            except Exception:
+                log.exception("gossip data failed", peer=peer.id[:8])
+                time.sleep(self.gossip_sleep)
+
+    def _gossip_data_once(self, peer: Peer, ps: PeerState) -> bool:
+        rs = self.cs.get_round_state()
+        prs = ps.prs
+        # 1. same height/round: send missing block parts
+        if rs.proposal_block_parts is not None and \
+                rs.height == prs.height and rs.round == prs.round:
+            parts = rs.proposal_block_parts
+            ours = [parts.has_part(i) for i in range(parts.total)]
+            idx = ps.pick_missing(ours, prs.proposal_block_parts)
+            if idx is not None:
+                part = parts.get_part(idx)
+                if peer.send(DATA_CHANNEL, M.encode_msg(
+                        M.BlockPartMessage(rs.height, rs.round, part))):
+                    ps.set_has_part(rs.height, idx)
+                return True
+        # 2. peer behind: feed it the committed block at its height
+        if 0 < prs.height < rs.height and \
+                prs.height <= self.cs.block_store.height:
+            meta = self.cs.block_store.load_block_meta(prs.height)
+            if meta is not None:
+                if prs.proposal_block_parts is None:
+                    ps.init_proposal_block_parts(meta.block_id.parts)
+                ours = [True] * meta.block_id.parts.total
+                idx = ps.pick_missing(ours, prs.proposal_block_parts)
+                if idx is not None:
+                    part = self.cs.block_store.load_part(prs.height, idx)
+                    if part is not None and peer.send(
+                            DATA_CHANNEL, M.encode_msg(M.BlockPartMessage(
+                                prs.height, prs.round, part))):
+                        ps.set_has_part(prs.height, idx)
+                    return True
+        # 3. send the proposal itself (+ POL)
+        if rs.proposal is not None and rs.height == prs.height and \
+                rs.round == prs.round and not prs.proposal:
+            if peer.send(DATA_CHANNEL,
+                         M.encode_msg(M.ProposalMessage(rs.proposal))):
+                ps.set_has_proposal(rs.proposal)
+            if 0 <= rs.proposal.pol_round and rs.votes is not None:
+                pol = rs.votes.prevotes(rs.proposal.pol_round)
+                if pol is not None:
+                    peer.send(DATA_CHANNEL, M.encode_msg(
+                        M.ProposalPOLMessage(
+                            height=rs.height,
+                            proposal_pol_round=rs.proposal.pol_round,
+                            proposal_pol=tuple(pol.bit_array()))))
+            return True
+        return False
+
+    def _gossip_votes_routine(self, peer: Peer, ps: PeerState,
+                              stop: threading.Event) -> None:
+        """Reference `gossipVotesRoutine` :537-643."""
+        while not stop.is_set():
+            try:
+                if not self._gossip_votes_once(peer, ps):
+                    time.sleep(self.gossip_sleep)
+            except Exception:
+                log.exception("gossip votes failed", peer=peer.id[:8])
+                time.sleep(self.gossip_sleep)
+
+    def _send_vote_from(self, peer: Peer, ps: PeerState, vs,
+                        theirs: list[bool] | None) -> bool:
+        if vs is None:
+            return False
+        idx = ps.pick_missing(vs.bit_array(), theirs)
+        if idx is None:
+            return False
+        vote = vs.get_by_index(idx)
+        if vote is None:
+            return False
+        if peer.send(VOTE_CHANNEL, M.encode_msg(M.VoteMessage(vote))):
+            ps.set_has_vote(vote.height, vote.round, vote.type, idx,
+                            vs.size())
+            return True
+        return False
+
+    def _gossip_votes_once(self, peer: Peer, ps: PeerState) -> bool:
+        rs = self.cs.get_round_state()
+        prs = ps.prs
+        n = rs.validators.size() if rs.validators else 0
+        if rs.height == prs.height and rs.votes is not None:
+            # peer waiting for the last commit at NewHeight
+            if prs.step == STEP_NEW_HEIGHT and rs.last_commit is not None:
+                theirs = ps._bits_for(rs.height - 1, prs.last_commit_round,
+                                      TYPE_PRECOMMIT, n)
+                if self._send_vote_from(peer, ps, rs.last_commit, theirs):
+                    return True
+            if prs.round >= 0 and prs.round <= rs.round:
+                pv = rs.votes.prevotes(prs.round)
+                if prs.step <= STEP_PREVOTE and self._send_vote_from(
+                        peer, ps, pv,
+                        ps._bits_for(rs.height, prs.round, TYPE_PREVOTE, n)):
+                    return True
+                pc = rs.votes.precommits(prs.round)
+                if prs.step <= STEP_PRECOMMIT_WAIT and self._send_vote_from(
+                        peer, ps, pc,
+                        ps._bits_for(rs.height, prs.round, TYPE_PRECOMMIT,
+                                     n)):
+                    return True
+                # commit-step peers still need precommits of their round
+                if self._send_vote_from(
+                        peer, ps, pc,
+                        ps._bits_for(rs.height, prs.round, TYPE_PRECOMMIT,
+                                     n)):
+                    return True
+            if prs.proposal_pol_round >= 0:
+                pol = rs.votes.prevotes(prs.proposal_pol_round)
+                if self._send_vote_from(
+                        peer, ps, pol,
+                        ps._bits_for(rs.height, prs.proposal_pol_round,
+                                     TYPE_PREVOTE, n)):
+                    return True
+            return False
+        # peer one height behind: our last_commit completes their commit
+        if prs.height != 0 and rs.height == prs.height + 1 and \
+                rs.last_commit is not None:
+            theirs = ps._bits_for(prs.height, prs.last_commit_round,
+                                  TYPE_PRECOMMIT, rs.last_commit.size())
+            if self._send_vote_from(peer, ps, rs.last_commit, theirs):
+                return True
+        # peer far behind: seen-commit precommits from the store
+        if prs.height != 0 and prs.height < rs.height and \
+                prs.height <= self.cs.block_store.height:
+            commit = self.cs.block_store.load_seen_commit(prs.height)
+            if commit is not None:
+                ps.ensure_catchup_commit(prs.height, commit.round(),
+                                         commit.size())
+                votes = [v for v in commit.precommits if v is not None]
+                with ps._lock:
+                    theirs = ps.prs.catchup_commit
+                    cands = [v for v in votes
+                             if theirs is None or
+                             not theirs[v.validator_index]]
+                if cands:
+                    vote = random.choice(cands)
+                    if peer.send(VOTE_CHANNEL,
+                                 M.encode_msg(M.VoteMessage(vote))):
+                        ps.set_has_vote(vote.height, vote.round, vote.type,
+                                        vote.validator_index, commit.size())
+                    return True
+        return False
+
+    def _query_maj23_routine(self, peer: Peer, ps: PeerState,
+                             stop: threading.Event) -> None:
+        """Advertise our two-thirds majorities so peers can prove theirs
+        (reference `queryMaj23Routine` :647-753)."""
+        while not stop.is_set():
+            time.sleep(MAJ23_SLEEP)
+            try:
+                rs = self.cs.get_round_state()
+                prs = ps.prs
+                if rs.height != prs.height or rs.votes is None:
+                    continue
+                for type_, getter in ((TYPE_PREVOTE, rs.votes.prevotes),
+                                      (TYPE_PRECOMMIT, rs.votes.precommits)):
+                    for r in range(0, rs.round + 1):
+                        vs = getter(r)
+                        maj = vs.two_thirds_majority() if vs else None
+                        if maj is not None:
+                            peer.try_send(STATE_CHANNEL, M.encode_msg(
+                                M.VoteSetMaj23Message(
+                                    height=rs.height, round=r, type=type_,
+                                    block_id=maj)))
+            except Exception:
+                log.exception("maj23 query failed", peer=peer.id[:8])

@@ -173,8 +173,23 @@ class ConsensusState:
         self._queue.put((M.BlockPartMessage(height, round_, part), peer_id))
 
     def set_peer_maj23(self, height, round_, type_, peer_id, block_id):
-        if height == self.height and self.votes is not None:
-            self.votes.set_peer_maj23(round_, type_, peer_id, block_id)
+        with self._mtx:   # receive thread swaps self.votes on every height
+            if height == self.height and self.votes is not None:
+                self.votes.set_peer_maj23(round_, type_, peer_id, block_id)
+
+    def get_round_state(self):
+        """Shallow snapshot of the RoundState for gossip routines
+        (reference `GetRoundState` consensus/state.go:292)."""
+        from types import SimpleNamespace
+        with self._mtx:
+            return SimpleNamespace(
+                height=self.height, round=self.round, step=self.step,
+                start_time=self.start_time, validators=self.validators,
+                proposal=self.proposal,
+                proposal_block_parts=self.proposal_block_parts,
+                locked_round=self.locked_round, votes=self.votes,
+                commit_round=self.commit_round,
+                last_commit=self.last_commit)
 
     def get_round_state_summary(self) -> dict:
         with self._mtx:

@@ -1,0 +1,91 @@
+"""Mempool reactor: transaction gossip.
+
+Reference: `mempool/reactor.go` — channel 0x30 (`:19`); a per-peer
+`broadcastTxRoutine` walks the pool and pushes txs the peer hasn't seen
+(`:111+`); inbound txs go through CheckTx like any local submission.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from tendermint_tpu.p2p.peer import Peer, Reactor
+from tendermint_tpu.p2p.types import ChannelDescriptor
+from tendermint_tpu.types.tx import Tx
+from tendermint_tpu.utils.log import get_logger
+
+log = get_logger("mempool")
+
+MEMPOOL_CHANNEL = 0x30
+BROADCAST_SLEEP = 0.02
+
+
+class MempoolReactor(Reactor):
+    def __init__(self, mempool, broadcast: bool = True):
+        super().__init__()
+        self.mempool = mempool
+        self.broadcast = broadcast
+        self._peer_stops: dict[str, threading.Event] = {}
+        self._lock = threading.Lock()
+
+    def get_channels(self):
+        return [ChannelDescriptor(id=MEMPOOL_CHANNEL, priority=5,
+                                  send_queue_capacity=100)]
+
+    def add_peer(self, peer: Peer) -> None:
+        if not self.broadcast:
+            return
+        stop = threading.Event()
+        with self._lock:
+            self._peer_stops[peer.id] = stop
+        threading.Thread(target=self._broadcast_tx_routine,
+                         args=(peer, stop), daemon=True,
+                         name=f"mempool-gossip-{peer.id[:8]}").start()
+
+    def remove_peer(self, peer: Peer, reason) -> None:
+        with self._lock:
+            stop = self._peer_stops.pop(peer.id, None)
+        if stop is not None:
+            stop.set()
+
+    def stop(self) -> None:
+        with self._lock:
+            for ev in self._peer_stops.values():
+                ev.set()
+
+    def receive(self, ch_id: int, peer: Peer, msg: bytes) -> None:
+        """A gossiped tx enters through CheckTx exactly like RPC
+        submissions (reference `:105-109`); the cache dedupes loops."""
+        if not msg:
+            return
+        try:
+            self.mempool.check_tx(msg)
+        except Exception:
+            log.exception("gossiped tx failed CheckTx", peer=peer.id[:8])
+
+    def _broadcast_tx_routine(self, peer: Peer,
+                              stop: threading.Event) -> None:
+        """Push pool txs the peer hasn't been sent yet (reference's
+        clist walk with NextWait becomes a sent-set sweep)."""
+        sent: set[bytes] = set()
+        while not stop.is_set():
+            try:
+                txs = self.mempool.txs_after(0)
+                live = set()
+                pushed = False
+                for tx in txs:
+                    h = Tx(tx).hash
+                    live.add(h)
+                    if h in sent:
+                        continue
+                    if peer.send(MEMPOOL_CHANNEL, tx, timeout=5.0):
+                        sent.add(h)
+                        pushed = True
+                # prune hashes no longer in the pool (committed/evicted)
+                sent &= live
+                if not pushed:
+                    time.sleep(BROADCAST_SLEEP)
+            except Exception:
+                log.exception("tx broadcast failed", peer=peer.id[:8])
+                time.sleep(BROADCAST_SLEEP)

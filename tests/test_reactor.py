@@ -1,0 +1,187 @@
+"""Consensus + mempool reactors over the real p2p stack.
+
+The nets here converge through gossip only — no direct broadcast_cb
+wiring — mirroring the reference's `consensus/reactor_test.go` and
+`consensus/byzantine_test.go` (4 validators, one equivocating, honest
+nodes still commit and capture evidence).
+"""
+
+import threading
+import time
+
+import pytest
+
+from tendermint_tpu.blockchain.store import BlockStore
+from tendermint_tpu.config import test_config as fast_config
+from tendermint_tpu.consensus.reactor import (ConsensusReactor,
+                                              VOTE_CHANNEL)
+from tendermint_tpu.consensus import messages as M
+from tendermint_tpu.consensus.state import ConsensusState
+from tendermint_tpu.crypto import backend as cb
+from tendermint_tpu.mempool.mempool import Mempool
+from tendermint_tpu.mempool.reactor import MempoolReactor
+from tendermint_tpu.proxy import ClientCreator
+from tendermint_tpu.p2p import connect_switches, make_switch
+from tendermint_tpu.state.state import get_state
+from tendermint_tpu.types import Vote
+from tendermint_tpu.types import events as ev
+from tendermint_tpu.utils.db import MemDB
+
+from chainutil import make_genesis, make_validators
+
+CHAIN = "reactor-chain"
+
+
+@pytest.fixture(autouse=True)
+def _python_backend():
+    old = cb._current
+    cb.set_backend("python")
+    yield
+    cb._current = old
+
+
+class NetNode:
+    """Consensus core + reactors + switch, no RPC/CLI."""
+
+    def __init__(self, priv, gen, moniker):
+        cfg = fast_config()
+        db = MemDB()
+        st = get_state(db, gen)
+        self.conns = ClientCreator("kvstore").new_app_conns()
+        self.mempool = Mempool(self.conns.mempool)
+        self.block_store = BlockStore(MemDB())
+        self.cs = ConsensusState(cfg.consensus, st, self.conns.consensus,
+                                 self.block_store, self.mempool,
+                                 priv_validator=priv)
+        self.cons_reactor = ConsensusReactor(self.cs)
+        self.mp_reactor = MempoolReactor(self.mempool)
+        self.switch = make_switch(CHAIN, {
+            "consensus": self.cons_reactor,
+            "mempool": self.mp_reactor,
+        }, moniker=moniker)
+
+    def start(self):
+        self.switch.start()
+
+    def stop(self):
+        self.switch.stop()
+
+
+def _make_net(n, connect=True):
+    privs, vs = make_validators(n)
+    gen = make_genesis(CHAIN, privs)
+    nodes = [NetNode(privs[i], gen, f"node{i}") for i in range(n)]
+    for nd in nodes:
+        nd.start()
+    if connect:
+        for i in range(n):
+            for j in range(i + 1, n):
+                connect_switches(nodes[i].switch, nodes[j].switch)
+    return nodes, privs
+
+
+def _wait_height(nodes, height, timeout=30.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if all(nd.block_store.height >= height for nd in nodes):
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_four_nodes_converge_through_reactors():
+    nodes, _ = _make_net(4)
+    try:
+        nodes[0].mempool.check_tx(b"gossip=me")
+        assert _wait_height(nodes, 3), \
+            f"heights: {[nd.block_store.height for nd in nodes]}"
+        for h in range(1, 4):
+            hashes = {nd.block_store.load_block(h).hash() for nd in nodes}
+            assert len(hashes) == 1, f"disagreement at height {h}"
+        # the tx was gossiped from node0's mempool and committed everywhere
+        all_txs = [tx for h in range(1, nodes[1].block_store.height + 1)
+                   for tx in nodes[1].block_store.load_block(h).txs]
+        assert b"gossip=me" in all_txs
+    finally:
+        for nd in nodes:
+            nd.stop()
+
+
+def test_late_joiner_catches_up_through_gossip():
+    """3 of 4 nodes advance; the 4th connects late and must catch up via
+    the catchup vote/part gossip paths (reference gossip routines'
+    prs.Height < rs.Height branches)."""
+    nodes, _ = _make_net(4, connect=False)
+    try:
+        for i in range(3):
+            for j in range(i + 1, 3):
+                connect_switches(nodes[i].switch, nodes[j].switch)
+        assert _wait_height(nodes[:3], 3), \
+            f"heights: {[nd.block_store.height for nd in nodes[:3]]}"
+        late = nodes[3]
+        assert late.block_store.height == 0
+        for i in range(3):
+            connect_switches(nodes[i].switch, late.switch)
+        assert _wait_height([late], 3, timeout=30), \
+            f"late joiner stuck at {late.block_store.height}"
+        for h in range(1, 4):
+            assert late.block_store.load_block(h).hash() == \
+                nodes[0].block_store.load_block(h).hash()
+    finally:
+        for nd in nodes:
+            nd.stop()
+
+
+def test_byzantine_double_signer_evidence_and_safety():
+    """Validator 0 equivocates: for every prevote it also signs and
+    broadcasts a conflicting nil prevote (raw key, no HRS guard).  Honest
+    nodes must capture DuplicateVoteEvidence AND keep committing — one
+    byzantine voice among 4 equal-power validators cannot break safety
+    (reference `consensus/byzantine_test.go:27-60`)."""
+    nodes, privs = _make_net(4)
+    byz = nodes[0]
+    byz_priv = privs[0]
+    evidence = []
+    ev_lock = threading.Lock()
+    for nd in nodes[1:]:
+        nd.cs.evsw.subscribe("test", "EvidenceDoubleSign",
+                             lambda e: (ev_lock.acquire(),
+                                        evidence.append(e),
+                                        ev_lock.release()))
+
+    orig_sign_add = byz.cs._sign_add_vote
+
+    def equivocating_sign_add(type_, block_id):
+        orig_sign_add(type_, block_id)
+        from tendermint_tpu.types import ZERO_BLOCK_ID, TYPE_PREVOTE
+        if type_ != TYPE_PREVOTE or block_id.is_zero():
+            return
+        # conflicting nil prevote signed with the raw key (bypasses the
+        # PrivValidator double-sign guard, like ByzantinePrivValidator)
+        idx = byz.cs.validators.index_of(byz_priv.address)
+        v = Vote(validator_address=byz_priv.address, validator_index=idx,
+                 height=byz.cs.height, round=byz.cs.round, type=type_,
+                 block_id=ZERO_BLOCK_ID)
+        sig = byz_priv.priv_key.sign(v.sign_bytes(CHAIN))
+        v = Vote(**{**v.__dict__, "signature": sig})
+        byz.switch.broadcast(VOTE_CHANNEL,
+                             M.encode_msg(M.VoteMessage(v)))
+
+    byz.cs._sign_add_vote = equivocating_sign_add
+    try:
+        assert _wait_height(nodes[1:], 3), \
+            f"honest heights: {[nd.block_store.height for nd in nodes[1:]]}"
+        # hashes agree across honest nodes
+        for h in range(1, 4):
+            hashes = {nd.block_store.load_block(h).hash()
+                      for nd in nodes[1:]}
+            assert len(hashes) == 1
+        with ev_lock:
+            assert evidence, "no double-sign evidence captured"
+        e = evidence[0]
+        assert e.vote_a.validator_address == byz_priv.address
+        assert e.vote_b.validator_address == byz_priv.address
+        assert e.vote_a.block_id.key() != e.vote_b.block_id.key()
+    finally:
+        for nd in nodes:
+            nd.stop()
