@@ -382,7 +382,8 @@ class ConsensusReactor(Reactor):
                 if peer.send(DATA_CHANNEL, M.encode_msg(
                         M.BlockPartMessage(rs.height, rs.round, part))):
                     ps.set_has_part(rs.height, idx)
-                return True
+                    return True
+                return False
         # 2. peer behind: feed it the committed block at its height
         if 0 < prs.height < rs.height and \
                 prs.height <= self.cs.block_store.height:
@@ -398,7 +399,8 @@ class ConsensusReactor(Reactor):
                             DATA_CHANNEL, M.encode_msg(M.BlockPartMessage(
                                 prs.height, prs.round, part))):
                         ps.set_has_part(prs.height, idx)
-                    return True
+                        return True
+                    return False
         # 3. send the proposal itself (+ POL)
         if rs.proposal is not None and rs.height == prs.height and \
                 rs.round == prs.round and not prs.proposal:
@@ -506,7 +508,7 @@ class ConsensusReactor(Reactor):
                                  M.encode_msg(M.VoteMessage(vote))):
                         ps.set_has_vote(vote.height, vote.round, vote.type,
                                         vote.validator_index, commit.size())
-                    return True
+                        return True
         return False
 
     def _query_maj23_routine(self, peer: Peer, ps: PeerState,

@@ -139,11 +139,16 @@ class ConsensusState:
     # lifecycle
     # ------------------------------------------------------------------
     def start(self) -> None:
+        if self._stopped.is_set():
+            return
         if self.wal is not None:
             self._catchup_replay()
-        self._thread = threading.Thread(target=self._receive_routine,
-                                        daemon=True, name="consensus")
-        self._thread.start()
+        t = threading.Thread(target=self._receive_routine,
+                             daemon=True, name="consensus")
+        t.start()
+        # assign only after start: stop() may run concurrently (fast-sync
+        # handoff racing a shutdown) and must never join an unstarted thread
+        self._thread = t
         self._schedule_round_0()
 
     def stop(self) -> None:

@@ -35,10 +35,21 @@ class Peer:
         with self._data_lock:
             self.data[key] = value
 
+    def supports_channel(self, ch_id: int) -> bool:
+        """Peers advertise channels in the handshake; sending on one the
+        remote lacks would kill the connection (its recv routine treats
+        unknown channels as protocol errors)."""
+        chs = self.node_info.channels
+        return not chs or ch_id in chs
+
     def send(self, ch_id: int, msg: bytes, timeout: float = 10.0) -> bool:
+        if not self.supports_channel(ch_id):
+            return False
         return self.mconn.send(ch_id, msg, timeout)
 
     def try_send(self, ch_id: int, msg: bytes) -> bool:
+        if not self.supports_channel(ch_id):
+            return False
         return self.mconn.try_send(ch_id, msg)
 
     def stop(self) -> None:

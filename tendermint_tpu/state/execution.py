@@ -42,8 +42,15 @@ class TxEvent:
     index: int
 
 
-def validate_block(state: State, block) -> None:
-    """Full contextual validation (reference `state/execution.go:173-202`)."""
+def validate_block(state: State, block, check_last_commit: bool = True) -> None:
+    """Full contextual validation (reference `state/execution.go:173-202`).
+
+    `check_last_commit=False` skips the +2/3 signature verification — for
+    the fast-sync pipeline, which verifies every commit in a batched
+    device call BEFORE applying (so re-verifying here would double the
+    dominant cost; the reference does pay it twice,
+    `blockchain/reactor.go:230` then `state/execution.go:177-202`).
+    """
     block.validate_basic()
     h = block.header
     if h.chain_id != state.chain_id:
@@ -59,11 +66,13 @@ def validate_block(state: State, block) -> None:
     if h.validators_hash != state.validators.hash():
         raise ValueError("wrong validators_hash")
     if h.height > 1:
-        # THE hot verification: +2/3 of last_validators signed last block
         if len(block.last_commit.precommits) != state.last_validators.size():
             raise ValueError("last_commit size != last validator set")
-        state.last_validators.verify_commit(
-            state.chain_id, h.last_block_id, h.height - 1, block.last_commit)
+        if check_last_commit:
+            # THE hot verification: +2/3 of last_validators signed last
+            state.last_validators.verify_commit(
+                state.chain_id, h.last_block_id, h.height - 1,
+                block.last_commit)
 
 
 def exec_block_on_app(proxy_consensus, block, event_cache: EventCache | None):
@@ -86,11 +95,12 @@ def exec_block_on_app(proxy_consensus, block, event_cache: EventCache | None):
 
 
 def apply_block(state: State, event_cache, proxy_consensus, block,
-                part_set_header, mempool, tx_indexer=None) -> State:
+                part_set_header, mempool, tx_indexer=None,
+                check_last_commit: bool = True) -> State:
     """Validate, execute, commit one block; returns the advanced state
     (reference `state/execution.go:210-245`).  Mutates `state` in place
     and persists it; callers pass a copy if they need the old one."""
-    validate_block(state, block)
+    validate_block(state, block, check_last_commit=check_last_commit)
     fail_point("ApplyBlock.validated")
     resp = exec_block_on_app(proxy_consensus, block, event_cache)
     fail_point("ApplyBlock.executed")

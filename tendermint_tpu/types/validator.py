@@ -19,6 +19,30 @@ from tendermint_tpu.types.codec import Reader, i64, lp_bytes, u32
 from tendermint_tpu.types.keys import PubKey
 
 
+class CommitSignatureError(ValueError):
+    """A commit carries an invalid signature.  In fast-sync the commit for
+    height h travels in block h+1's LastCommit, so the *successor's*
+    deliverer is at fault."""
+
+    def __init__(self, height: int, lane: int):
+        super().__init__(
+            f"invalid commit signature at height {height} (lane {lane})")
+        self.height = height
+        self.lane = lane
+
+
+class CommitPowerError(ValueError):
+    """A commit's tallied power for the expected block is below +2/3 —
+    either the block content was tampered (votes point at a different
+    block id) or the commit is genuinely short."""
+
+    def __init__(self, height: int, tallied: int, total: int):
+        super().__init__(
+            f"insufficient voting power at height {height}: "
+            f"{tallied}/{total}")
+        self.height = height
+
+
 @dataclass
 class Validator:
     pub_key: PubKey
@@ -224,16 +248,48 @@ class ValidatorSet:
             chain_id, block_id, height, commit)
         ok = cb.verify_batch(pubs, msgs, sigs)
         if not ok.all():
-            bad = int(np.argmin(ok))
-            raise ValueError(f"invalid commit signature (lane {bad})")
+            raise CommitSignatureError(height, int(np.argmin(ok)))
         tallied = int(powers.sum())
         if not tallied * 3 > self._total * 2:
-            raise ValueError(
-                f"insufficient voting power: {tallied}/{self._total}")
+            raise CommitPowerError(height, tallied, self._total)
 
     def __str__(self):
         return (f"ValidatorSet[{self.size()} vals, "
                 f"power {self._total}]")
+
+
+def verify_commits_batched(val_set: ValidatorSet, chain_id: str,
+                           items: list[tuple]) -> None:
+    """Verify MANY commits against one validator set in a single device
+    call — the fast-sync window (`items` = [(block_id, height, commit)]).
+
+    This is the framework's generalization of the reference SYNC_LOOP's
+    one-at-a-time `Validators.VerifyCommit`
+    (reference `blockchain/reactor.go:230-231`): all (block x validator)
+    signature lanes flatten into one batch so the device sees a single
+    large verify instead of K small ones.  Raises ValueError naming the
+    first failing height.
+    """
+    from tendermint_tpu.crypto import backend as cb
+    if not items:
+        return
+    arrays = [val_set.commit_verify_arrays(chain_id, bid, h, c)
+              for bid, h, c in items]
+    counts = [len(a[0]) for a in arrays]
+    pubs = np.concatenate([a[0] for a in arrays])
+    msgs = np.concatenate([a[1] for a in arrays])
+    sigs = np.concatenate([a[2] for a in arrays])
+    ok = cb.verify_batch(pubs, msgs, sigs)
+    off = 0
+    total = val_set.total_voting_power()
+    for (bid, h, _), a, n in zip(items, arrays, counts):
+        lane_ok = ok[off:off + n]
+        off += n
+        if not lane_ok.all():
+            raise CommitSignatureError(h, int(np.argmin(lane_ok)))
+        tallied = int(a[3].sum())
+        if not tallied * 3 > total * 2:
+            raise CommitPowerError(h, tallied, total)
 
 
 def _neg_addr(addr: bytes) -> bytes:
