@@ -1,0 +1,322 @@
+"""Benchmark harness — BASELINE.md configs, one JSON headline line.
+
+Run: `python bench.py` (full), `python bench.py --quick` (small sizes),
+`python bench.py --config N` (one config).  Detail goes to stderr; the
+LAST stdout line is the single JSON object the driver records:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": R}
+
+vs_baseline anchors against the NATIVE single-threaded CPU verify rate
+(OpenSSL scalar loop — the "pure-Go-equivalent CPU path" BASELINE.md
+names), measured in-process on this host, never against pure Python.
+
+Configs (BASELINE.md table):
+  0  4-validator kvstore chain, fast-sync-style replay on the native
+     CPU backend — correctness + CPU blocks/s baseline
+  1  100-validator batch: ed25519 sigs, one device verify call
+  2  batched SHA-256 merkle tree roots (blocks x txs)
+  3  pipelined fast-sync replay, 100 validators: batched commit verify
+     + part-set re-hash + apply (the north star, scaled to bench time)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+# ---------------------------------------------------------------------------
+# fixture construction
+# ---------------------------------------------------------------------------
+
+def _sign_batch_fixture(n_vals: int, n_sigs: int):
+    """(pubs, msgs, sigs) uint8 arrays: n_sigs votes across n_vals keys."""
+    import numpy as np
+    from tendermint_tpu.crypto import native
+    from tendermint_tpu.crypto import pure_ed25519 as ref
+    from tendermint_tpu.types import canonical
+    sign = native.sign_one if native.AVAILABLE else ref.sign
+    seeds = [bytes([1 + (i % 250), 2 + (i // 250)]) + b"\x00" * 30
+             for i in range(n_vals)]
+    pubs_by_val = [ref.pubkey_from_seed(s) for s in seeds]
+    pubs, msgs, sigs = [], [], []
+    for i in range(n_sigs):
+        v = i % n_vals
+        h = 1 + i // n_vals
+        msg = canonical.sign_bytes("bench-chain", canonical.TYPE_PRECOMMIT,
+                                   h, 0, block_hash=b"\x11" * 32,
+                                   parts_hash=b"\x22" * 32, parts_total=2)
+        pubs.append(pubs_by_val[v])
+        msgs.append(msg)
+        sigs.append(sign(seeds[v], msg))
+    return (np.frombuffer(b"".join(pubs), np.uint8).reshape(n_sigs, 32),
+            np.frombuffer(b"".join(msgs), np.uint8).reshape(
+                n_sigs, canonical.SIGN_BYTES_LEN),
+            np.frombuffer(b"".join(sigs), np.uint8).reshape(n_sigs, 64))
+
+
+def _build_bench_chain(n_vals: int, n_blocks: int, txs_per_block: int = 1):
+    """Chain fixture with real commits; app hashes from a kvstore run."""
+    sys.path.insert(0, "tests")
+    from chainutil import (build_chain, kvstore_app_hashes, make_genesis,
+                           make_validators)
+    privs, vs = make_validators(n_vals)
+    gen = make_genesis("bench-chain", privs)
+    hashes = kvstore_app_hashes(n_blocks, txs_per_block)
+    chain = build_chain(privs, vs, "bench-chain", n_blocks,
+                        txs_per_block=txs_per_block, app_hashes=hashes)
+    return privs, vs, gen, chain
+
+
+# ---------------------------------------------------------------------------
+# native CPU anchor
+# ---------------------------------------------------------------------------
+
+def native_scalar_rate(n: int = 1500) -> float:
+    """Single-threaded native (OpenSSL) scalar verify rate — the
+    reference-equivalent CPU loop every vs_baseline anchors against."""
+    from tendermint_tpu.crypto import native
+    if not native.AVAILABLE:
+        log("native backend unavailable; anchoring against bigint python")
+        from tendermint_tpu.crypto import pure_ed25519 as ref
+        pubs, msgs, sigs = _sign_batch_fixture(4, 50)
+        t0 = time.perf_counter()
+        for i in range(50):
+            ref.verify(pubs[i].tobytes(), msgs[i].tobytes(),
+                       sigs[i].tobytes())
+        return 50 / (time.perf_counter() - t0)
+    pubs, msgs, sigs = _sign_batch_fixture(4, n)
+    rows = [(pubs[i].tobytes(), msgs[i].tobytes(), sigs[i].tobytes())
+            for i in range(n)]
+    t0 = time.perf_counter()
+    for r in rows:
+        if not native.verify_one(*r):
+            raise RuntimeError("bench fixture signature invalid")
+    return n / (time.perf_counter() - t0)
+
+
+# ---------------------------------------------------------------------------
+# configs
+# ---------------------------------------------------------------------------
+
+def config0_cpu_replay(quick: bool) -> dict:
+    """4-validator kvstore chain replayed through the batched sync path
+    on the NATIVE CPU backend."""
+    from tendermint_tpu.crypto import backend as cb
+    n_blocks = 100 if quick else 1000
+    res = _replay_chain(n_vals=4, n_blocks=n_blocks, backend="native",
+                        window=64)
+    res["config"] = 0
+    return res
+
+
+def config3_fastsync_cpu_anchor(n_blocks: int) -> dict:
+    """The same 100-validator replay pipeline on the single-threaded
+    native backend — the honest CPU baseline for the north star."""
+    from tendermint_tpu.crypto import native as native_mod
+    from tendermint_tpu.crypto import backend as cb
+
+    class _Scalar(native_mod.NativeBackend):
+        def __init__(self):
+            super().__init__(workers=1)
+    cb.register("native-scalar", _Scalar)
+    return _replay_chain(n_vals=100, n_blocks=n_blocks,
+                         backend="native-scalar", window=64)
+
+
+def config1_batch_verify(quick: bool, sizes=None) -> dict:
+    """One big device verify call (the vmap grid)."""
+    import numpy as np
+    from tendermint_tpu.crypto import backend as cb
+    sizes = sizes or ([4096] if quick else [65536, 32768, 16384])
+    backend = cb.set_backend("tpu")
+    last_err = None
+    for n in sizes:
+        try:
+            log(f"[config1] signing {n} fixtures...")
+            pubs, msgs, sigs = _sign_batch_fixture(100, n)
+            log(f"[config1] compiling + first call @ {n}...")
+            t0 = time.perf_counter()
+            ok = backend.verify_batch(pubs, msgs, sigs)
+            compile_s = time.perf_counter() - t0
+            if not ok.all():
+                raise RuntimeError("verify returned invalid lanes")
+            reps = 3
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                ok = backend.verify_batch(pubs, msgs, sigs)
+            steady = (time.perf_counter() - t0) / reps
+            rate = n / steady
+            log(f"[config1] n={n} compile+first={compile_s:.1f}s "
+                f"steady={steady:.3f}s rate={rate:.0f} sigs/s")
+            return {"config": 1, "sigs_per_sec": rate, "batch": n,
+                    "first_call_seconds": compile_s}
+        except Exception as e:          # OOM/compile failure: try smaller
+            last_err = e
+            log(f"[config1] n={n} failed: {e}")
+    raise RuntimeError(f"all batch sizes failed: {last_err}")
+
+
+def config2_merkle_batch(quick: bool) -> dict:
+    """Batched SHA-256 tree roots: B blocks x T tx-leaves."""
+    import numpy as np
+    from tendermint_tpu.ops import merkle as dev_merkle
+    from tendermint_tpu.types import merkle as host_merkle
+    import jax
+    B, T, L = (256, 128, 64) if quick else (2048, 1024, 64)
+    leaves = np.random.default_rng(0).integers(
+        0, 256, (B, T, L), dtype=np.uint8)
+    fn = jax.jit(dev_merkle.roots)
+    log(f"[config2] compiling merkle roots for {B}x{T} trees...")
+    t0 = time.perf_counter()
+    roots = np.asarray(fn(leaves))
+    compile_s = time.perf_counter() - t0
+    want = host_merkle.root_from_leaf_hashes(
+        [host_merkle.leaf_hash(leaves[0, i].tobytes()) for i in range(T)])
+    assert roots[0].tobytes() == want, "device merkle root mismatch"
+    reps = 3
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        roots = np.asarray(fn(leaves))
+    steady = (time.perf_counter() - t0) / reps
+    # host anchor: C-speed hashlib tree over the same data (sampled)
+    sample = min(B, 64)
+    t0 = time.perf_counter()
+    for b in range(sample):
+        host_merkle.root_from_leaf_hashes(
+            [host_merkle.leaf_hash(leaves[b, i].tobytes())
+             for i in range(T)])
+    host_rate = sample / (time.perf_counter() - t0)
+    rate = B / steady
+    log(f"[config2] {B}x{T} trees: device {rate:.0f} trees/s "
+        f"(first call {compile_s:.1f}s), host {host_rate:.0f} trees/s")
+    return {"config": 2, "trees_per_sec": rate, "host_trees_per_sec":
+            host_rate, "blocks": B, "txs": T}
+
+
+def _replay_chain(n_vals: int, n_blocks: int, backend: str,
+                  window: int | None = None,
+                  target_lanes: int = 16384) -> dict:
+    """Shared replay pipeline: batched commit verify + part re-hash +
+    apply, identical to BlockchainReactor._sync_step minus networking."""
+    from tendermint_tpu.crypto import backend as cb
+    from tendermint_tpu.state import execution
+    from tendermint_tpu.state.state import get_state
+    from tendermint_tpu.proxy import ClientCreator
+    from tendermint_tpu.types import BlockID
+    from tendermint_tpu.types.validator import verify_commits_batched
+    from tendermint_tpu.utils.db import MemDB
+
+    if window is None:
+        # fill the device batch bucket: occupancy is throughput
+        window = max(1, min(n_blocks, target_lanes // n_vals))
+    log(f"[replay] building {n_blocks}-block chain, {n_vals} validators...")
+    privs, vs, gen, chain = _build_bench_chain(n_vals, n_blocks)
+    cb.set_backend(backend)
+    state = get_state(MemDB(), gen)
+    conns = ClientCreator("kvstore").new_app_conns()
+    total_sigs = 0
+    log(f"[replay] replaying on backend={backend} window={window}...")
+    # warm-up: compile the verify graph for this window's bucket outside
+    # the timed region (a real node pays this once per process, and the
+    # persistent compile cache makes restarts cheap)
+    warm = chain[:window]
+    _warm_items = []
+    for block, _, seen in warm:
+        parts = block.make_part_set()
+        _warm_items.append((BlockID(block.hash(), parts.header),
+                            block.height, seen))
+    verify_commits_batched(state.validators, state.chain_id, _warm_items)
+    t0 = time.perf_counter()
+    i = 0
+    while i < len(chain):
+        blocks = chain[i:i + window]
+        items = []
+        for j, (block, _, seen) in enumerate(blocks):
+            parts = block.make_part_set()           # re-hash like fast-sync
+            bid = BlockID(block.hash(), parts.header)
+            items.append((bid, block.height, seen, parts))
+        verify_commits_batched(
+            state.validators, state.chain_id,
+            [(bid, h, c) for bid, h, c, _ in items])
+        total_sigs += sum(len(c.precommits) for _, _, c, _ in items)
+        for (block, _, seen), (bid, h, c, parts) in zip(blocks, items):
+            execution.apply_block(state, None, conns.consensus, block,
+                                  parts.header, execution.MockMempool(),
+                                  check_last_commit=False)
+        i += window
+    dt = time.perf_counter() - t0
+    assert state.last_block_height == n_blocks
+    out = {"blocks_per_sec": n_blocks / dt, "sigs_per_sec": total_sigs / dt,
+           "blocks": n_blocks, "validators": n_vals, "seconds": dt}
+    log(f"[replay] backend={backend}: {out['blocks_per_sec']:.1f} blocks/s "
+        f"{out['sigs_per_sec']:.0f} sigs/s over {dt:.1f}s")
+    return out
+
+
+def config3_fastsync(quick: bool) -> dict:
+    """North star: pipelined replay with batched device verification,
+    100 validators, vs the same pipeline on the scalar CPU backend."""
+    n_blocks = 326 if quick else 978    # multiples of the 163-block window
+    res = _replay_chain(n_vals=100, n_blocks=n_blocks, backend="tpu")
+    anchor = config3_fastsync_cpu_anchor(64 if quick else 128)
+    res["cpu_pipeline_sigs_per_sec"] = anchor["sigs_per_sec"]
+    res["cpu_pipeline_blocks_per_sec"] = anchor["blocks_per_sec"]
+    res["config"] = 3
+    return res
+
+
+# ---------------------------------------------------------------------------
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--config", type=int, default=None)
+    args = ap.parse_args()
+
+    results = {}
+    log(f"[bench] anchoring native CPU scalar rate...")
+    anchor = native_scalar_rate(300 if args.quick else 1500)
+    log(f"[bench] native scalar anchor: {anchor:.0f} sigs/s")
+    results["native_scalar_sigs_per_sec"] = anchor
+
+    configs = {0: config0_cpu_replay, 1: config1_batch_verify,
+               2: config2_merkle_batch, 3: config3_fastsync}
+    run = ([args.config] if args.config is not None
+           else ([1, 3] if args.quick else [0, 1, 2, 3]))
+    for c in run:
+        try:
+            results[f"config{c}"] = configs[c](args.quick)
+        except Exception as e:
+            log(f"[bench] config {c} FAILED: {e}")
+            import traceback
+            traceback.print_exc(file=sys.stderr)
+            results[f"config{c}"] = {"error": str(e)}
+
+    # headline: the north-star replay if it ran, else raw batch verify
+    c3 = results.get("config3", {})
+    c1 = results.get("config1", {})
+    if "sigs_per_sec" in c3:
+        headline = {"metric": "fastsync_replay_commit_sigs_per_sec",
+                    "value": round(c3["sigs_per_sec"], 1),
+                    "unit": "sigs/s",
+                    "vs_baseline": round(c3["sigs_per_sec"] / anchor, 2)}
+    elif "sigs_per_sec" in c1:
+        headline = {"metric": "batch_verify_sigs_per_sec",
+                    "value": round(c1["sigs_per_sec"], 1),
+                    "unit": "sigs/s",
+                    "vs_baseline": round(c1["sigs_per_sec"] / anchor, 2)}
+    else:
+        headline = {"metric": "bench_failed", "value": 0, "unit": "",
+                    "vs_baseline": 0}
+    log("[bench] detail: " + json.dumps(results, default=str))
+    print(json.dumps(headline), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -121,9 +121,15 @@ def _enable_compile_cache() -> None:
         pass  # cache is an optimization; never block startup on it
 
 
+def _native_backend():
+    from tendermint_tpu.crypto.native import NativeBackend
+    return NativeBackend()
+
+
 _BACKENDS = {
     "python": PythonBackend,
     "tpu": TpuBackend,
+    "native": _native_backend,
 }
 
 _lock = threading.Lock()

@@ -12,8 +12,11 @@ from tendermint_tpu.types import (Block, BlockID, Commit, EMPTY_COMMIT,
                                   GenesisDoc, GenesisValidator, PrivKey,
                                   PrivValidator, TYPE_PRECOMMIT, Validator,
                                   ValidatorSet, Vote, VoteSet, ZERO_BLOCK_ID)
+from tendermint_tpu.types.part_set import PART_SIZE as _PROD_PART_SIZE
 
-PART_SIZE = 4096
+# the production part size: fast-sync re-chunks blocks with the default,
+# so fixture commits must sign the same parts header it will recompute
+PART_SIZE = _PROD_PART_SIZE
 
 
 def make_validators(n: int, power: int = 10, seed: int = 0):

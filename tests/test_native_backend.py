@@ -1,0 +1,126 @@
+"""Native (OpenSSL) backend vs the golden bigint ed25519.
+
+The live-vote path verifies through the native scalar verifier while the
+batch paths use the device/golden implementations — any semantic
+disagreement between them would let an adversarial signature split our
+own consensus.  This differential suite probes the classic edge cases:
+malleated s >= L, non-canonical point encodings, tampered bits, and
+truncated inputs (reference test strategy: SURVEY.md §4 "new tiers").
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from tendermint_tpu.crypto import backend as cb
+from tendermint_tpu.crypto import native
+from tendermint_tpu.crypto import pure_ed25519 as ref
+
+pytestmark = pytest.mark.skipif(not native.AVAILABLE,
+                                reason="cryptography not installed")
+
+
+def _cases():
+    """(pubkey, msg, sig, label) adversarial corpus."""
+    out = []
+    seed = b"\x07" * 32
+    pub = ref.pubkey_from_seed(seed)
+    msg = b"vote sign bytes " * 8
+    sig = ref.sign(seed, msg)
+    out.append((pub, msg, sig, "valid"))
+    # tampered message / signature / pubkey single bits
+    out.append((pub, msg[:-1] + b"\x00", sig, "tampered msg"))
+    out.append((pub, msg, sig[:32] + bytes([sig[32] ^ 1]) + sig[33:],
+                "tampered s"))
+    out.append((pub, msg, bytes([sig[0] ^ 1]) + sig[1:], "tampered R"))
+    out.append((bytes([pub[0] ^ 1]) + pub[1:], msg, sig, "tampered pub"))
+    # malleated: s' = s + L (same point equation, non-canonical scalar)
+    s = int.from_bytes(sig[32:], "little")
+    s_mall = s + ref.L
+    if s_mall < 2**256:
+        out.append((pub, msg, sig[:32] + s_mall.to_bytes(32, "little"),
+                    "malleated s+L"))
+    # s >= L outright
+    out.append((pub, msg, sig[:32] + ref.L.to_bytes(32, "little"),
+                "s == L"))
+    out.append((pub, msg, sig[:32] + b"\xff" * 32, "s max"))
+    # non-canonical R encoding: y >= p
+    bad_y = (ref.P + 1).to_bytes(32, "little")
+    out.append((pub, msg, bad_y + sig[32:], "non-canonical R"))
+    out.append((bad_y, msg, sig, "non-canonical A"))
+    # all-zero signature / pubkey
+    out.append((pub, msg, b"\x00" * 64, "zero sig"))
+    out.append((b"\x00" * 32, msg, sig, "zero pub"))
+    # identity-point pubkey (y=1)
+    ident = (1).to_bytes(32, "little")
+    out.append((ident, msg, sig, "identity pub"))
+    # random garbage rounds
+    rng = np.random.default_rng(42)
+    for i in range(20):
+        out.append((bytes(rng.integers(0, 256, 32, dtype=np.uint8)),
+                    msg, bytes(rng.integers(0, 256, 64, dtype=np.uint8)),
+                    f"random {i}"))
+    # more valid ones with varied lengths
+    for i in range(5):
+        sd = bytes([i + 1]) * 32
+        m = bytes([i]) * (16 + i * 37)
+        out.append((ref.pubkey_from_seed(sd), m, ref.sign(sd, m),
+                    f"valid {i}"))
+    return out
+
+
+def test_native_matches_golden_on_adversarial_corpus():
+    mismatches = []
+    for pub, msg, sig, label in _cases():
+        want = ref.verify(pub, msg, sig)
+        got = native.verify_one(pub, msg, sig)
+        if want != got:
+            mismatches.append((label, want, got))
+    assert not mismatches, f"backend disagreement: {mismatches}"
+
+
+def test_native_batch_backend():
+    old = cb._current
+    try:
+        backend = cb.set_backend("native")
+        cases = [(p, m, s) for p, m, s, _ in _cases() if len(m) == 128]
+        seed = b"\x09" * 32
+        msg = b"m" * 128
+        cases += [(ref.pubkey_from_seed(seed), msg, ref.sign(seed, msg))]
+        pubs = np.frombuffer(b"".join(c[0] for c in cases),
+                             np.uint8).reshape(-1, 32)
+        msgs = np.frombuffer(b"".join(c[1] for c in cases),
+                             np.uint8).reshape(-1, 128)
+        sigs = np.frombuffer(b"".join(c[2] for c in cases),
+                             np.uint8).reshape(-1, 64)
+        got = backend.verify_batch(pubs, msgs, sigs)
+        want = [ref.verify(*c) for c in cases]
+        assert list(got) == want
+    finally:
+        cb._current = old
+
+
+def test_native_sign_is_byte_identical():
+    """Signing dispatches to OpenSSL; RFC 8032 determinism means the
+    bytes must equal the golden implementation exactly."""
+    for i in range(8):
+        seed = bytes([i + 1]) * 32
+        msg = bytes([i]) * (1 + i * 29)
+        assert native.sign_one(seed, msg) == ref.sign(seed, msg)
+
+
+def test_native_speed_is_native():
+    """The point of the backend: ≥ 2k sigs/s scalar (the bigint path does
+    ~200/s) — generous bound so slow CI hosts still pass."""
+    import time
+    seed = b"\x0a" * 32
+    msg = b"m" * 128
+    pub, sig = ref.pubkey_from_seed(seed), ref.sign(seed, msg)
+    native.verify_one(pub, msg, sig)       # warm imports
+    n = 500
+    t0 = time.perf_counter()
+    for _ in range(n):
+        assert native.verify_one(pub, msg, sig)
+    rate = n / (time.perf_counter() - t0)
+    assert rate > 2000, f"native verify too slow: {rate:.0f}/s"
