@@ -188,6 +188,57 @@ def scalar_mul(s: jnp.ndarray, Q) -> tuple:
     return acc
 
 
+def build_comb_tables(Q) -> tuple:
+    """Per-point fixed-base comb tables, built ON DEVICE.
+
+    Q: point with coords [..., V, 32] (V points, e.g. one per validator).
+    Returns per-coordinate arrays [32, 256, ..., V, 32]:
+    entry[w, j] = j * 2^(8w) * Q — so [k]Q needs just 32 table adds and
+    ZERO doublings (the structure of `scalar_mul_base`, generalized to
+    runtime points).
+
+    Why: fast-sync verifies thousands of commits against the SAME
+    validator set; amortizing the ~2.7k field muls of a cold variable-base
+    ladder into a cached table leaves ~0.3k muls per signature.  Build is
+    one device call: a 256-step add scan for window 0, then 8 parallel
+    doublings per window — sequential depth ~500 point ops over wide
+    [256 x V] batches.
+    """
+    def add_step(acc, _):
+        nxt = pt_add(acc, Q)
+        return nxt, acc
+    _, row0 = lax.scan(add_step, identity(Q[0].shape[:-1]), None,
+                       length=256)
+    # row0 coords: [256, ..., V, 32]
+
+    def window_step(row, _):
+        nxt = row
+        for _ in range(8):              # x256 = shift one 8-bit window up
+            nxt = pt_dbl(nxt)
+        return nxt, row
+
+    _, rows = lax.scan(window_step, row0, None, length=32)
+    return rows                          # [32, 256, ..., V, 32] per coord
+
+
+def scalar_mul_comb(tbl, val_idx: jnp.ndarray, s: jnp.ndarray) -> tuple:
+    """[s] * Q_{val_idx} from comb tables.
+
+    tbl: build_comb_tables output [32, 256, V, 32] per coord;
+    val_idx int32 [N]; s bytes/limbs [N, 32] -> point coords [N, 32].
+    32 gathered extended adds, no doublings.
+    """
+    digits = jnp.moveaxis(s.astype(jnp.int32), -1, 0)   # [32, N]
+
+    def body(acc, xs):
+        digit, tw = xs                   # tw: [256, V, 32] per coord
+        sel = tuple(t[digit, val_idx] for t in tw)       # [N, 32]
+        return pt_add(acc, sel), None
+
+    acc, _ = lax.scan(body, identity(s.shape[:-1]), (digits, tbl))
+    return acc
+
+
 @functools.lru_cache(maxsize=None)
 def _base_table() -> np.ndarray:
     """np.int32[32, 256, 3, 32]: window w, digit j -> affine precomp of

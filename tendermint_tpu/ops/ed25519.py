@@ -55,3 +55,41 @@ def verify(pubkeys: jnp.ndarray, msgs: jnp.ndarray,
 
 verify_batch = jax.jit(verify)
 """jitted entry point; jax caches one executable per (batch, msg_len) shape."""
+
+
+def build_neg_comb(pubkeys: jnp.ndarray) -> tuple:
+    """Decompress V pubkeys and build comb tables of THEIR NEGATIONS
+    (verification needs [k](-A)).  Returns (tables, ok[V]).
+
+    One device call per validator set; the tables then serve every
+    subsequent verify against that set (see `crypto.backend`'s cache).
+    """
+    A, ok = curve.decompress(pubkeys)
+    return curve.build_comb_tables(curve.pt_neg(A)), ok
+
+
+build_neg_comb_jit = jax.jit(build_neg_comb)
+
+
+def verify_grouped(tables, pub_ok: jnp.ndarray, val_idx: jnp.ndarray,
+                   pubkeys: jnp.ndarray, msgs: jnp.ndarray,
+                   sigs: jnp.ndarray) -> jnp.ndarray:
+    """Grouped verify: lane i checks sig[i] by validator val_idx[i] using
+    the cached comb tables — ~4x fewer field muls than `verify` (no
+    per-lane pubkey decompress, no variable-base ladder).
+
+    pubkeys[N, 32] are the PER-LANE keys (only for the challenge hash
+    k = H(R||A||M); group math comes from the tables).
+    """
+    challenge = jnp.concatenate([sigs[..., :32], pubkeys, msgs], axis=-1)
+    k = sc.reduce512(s512.sha512(challenge))
+    R, ok_r = curve.decompress(sigs[..., :32])
+    s_bytes = sigs[..., 32:]
+    ok_s = sc.lt_L(s_bytes)
+    sB = curve.scalar_mul_base(s_bytes)
+    kA = curve.scalar_mul_comb(tables, val_idx, k)
+    Rprime = curve.pt_add(sB, kA)
+    return pub_ok[val_idx] & ok_r & ok_s & curve.pt_eq(Rprime, R)
+
+
+verify_grouped_jit = jax.jit(verify_grouped)
