@@ -161,6 +161,25 @@ class BlockchainReactor(Reactor):
             return False
         window = blocks[:-1]              # each needs its successor's
         chain_id = self.state.chain_id    # LastCommit as its +2/3 proof
+        # Each header commits to the validator set of ITS height.  EndBlock
+        # diffs can change the set mid-window, so verify only the prefix
+        # whose headers match the current set; the rest re-verifies next
+        # tick against the updated state (reference verifies per block:
+        # `blockchain/reactor.go:230-231`).
+        vals_hash = self.state.validators.hash()
+        cut = len(window)
+        for i, b in enumerate(window):
+            if b.header.validators_hash != vals_hash:
+                cut = i
+                break
+        if cut == 0:
+            # the very next block disagrees with our state's validator set:
+            # the block is bad (or stale) — re-fetch it from someone else
+            log.warn("next block's validators_hash mismatches state",
+                     height=window[0].height)
+            self.pool.redo(window[0].height)
+            return False
+        window = window[:cut]
         parts_list, items = [], []
         for i, b in enumerate(window):
             parts = b.make_part_set()     # re-hash, proving data integrity
@@ -184,15 +203,18 @@ class BlockchainReactor(Reactor):
             self.pool.redo(e.height)
             return False
         dt = time.perf_counter() - t0
-        vals_hash = self.state.validators.hash()
         applied = 0
         for b, parts, (bid, h, commit) in zip(window, parts_list, items):
-            self.pool.pop(1)
+            # store-before-state is the crash-recovery discipline (the
+            # handshake covers store==state+1); but the pool advances only
+            # AFTER a successful apply so an in-process app/WAL fault
+            # re-fetches and re-applies instead of wedging the sync.
             if self.store.height < b.height:
                 self.store.save_block(b, parts, commit)
             execution.apply_block(self.state, None, self.proxy, b,
                                   parts.header, execution.MockMempool(),
                                   check_last_commit=False)
+            self.pool.pop(1)
             REGISTRY.blocks_synced.inc()
             applied += 1
             new_hash = self.state.validators.hash()

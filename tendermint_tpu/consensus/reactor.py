@@ -371,9 +371,14 @@ class ConsensusReactor(Reactor):
     def _gossip_data_once(self, peer: Peer, ps: PeerState) -> bool:
         rs = self.cs.get_round_state()
         prs = ps.prs
-        # 1. same height/round: send missing block parts
+        # 1. same height/round: send missing block parts — but only once
+        #    the peer has the proposal (its parts bit-array is initialized
+        #    by set_has_proposal); receivers drop parts that arrive before
+        #    the ProposalMessage, so gossiping parts first would livelock
+        #    (reference gossipDataRoutine gates on ProposalBlockParts too).
         if rs.proposal_block_parts is not None and \
-                rs.height == prs.height and rs.round == prs.round:
+                rs.height == prs.height and rs.round == prs.round and \
+                prs.proposal_block_parts is not None:
             parts = rs.proposal_block_parts
             ours = [parts.has_part(i) for i in range(parts.total)]
             idx = ps.pick_missing(ours, prs.proposal_block_parts)

@@ -54,7 +54,7 @@ class PythonBackend:
             out[i] = _ref.verify(pubkeys[i].tobytes(), msgs[i].tobytes(),
                                  sigs[i].tobytes())
         REGISTRY.sigs_requested.inc(len(pubkeys))
-        REGISTRY.sigs_verified.inc(len(pubkeys))
+        REGISTRY.sigs_verified.inc(int(out.sum()))
         return out
 
 
@@ -91,7 +91,7 @@ class TpuBackend:
         out = np.asarray(out)
         REGISTRY.device_step_seconds.observe(time.perf_counter() - t0)
         REGISTRY.sigs_requested.inc(n)
-        REGISTRY.sigs_verified.inc(b)
+        REGISTRY.sigs_verified.inc(int(out[:n].sum()))
         REGISTRY.verify_batches.inc()
         REGISTRY.batch_occupancy.observe(n / b)
         return out[:n]

@@ -68,6 +68,7 @@ class NativeBackend:
             chunk = max(1, n // (self._workers * 4))
             out = list(self._pool.map(lambda r: verify_one(*r), rows,
                                       chunksize=chunk))
+        out = np.asarray(out, dtype=bool)
         REGISTRY.sigs_requested.inc(n)
-        REGISTRY.sigs_verified.inc(n)
-        return np.asarray(out, dtype=bool)
+        REGISTRY.sigs_verified.inc(int(out.sum()))
+        return out

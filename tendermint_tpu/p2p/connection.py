@@ -107,6 +107,7 @@ class MConnection:
         self._ping_interval = ping_interval
         self._flush_throttle = flush_throttle
         self._send_cv = threading.Condition()
+        self._pong_pending = 0      # PONGs owed; written by the send routine
         self._stopped = threading.Event()
         self._errored = False
         self._err_lock = threading.Lock()
@@ -197,15 +198,21 @@ class MConnection:
             while not self._stopped.is_set():
                 with self._send_cv:
                     ch = self._pick_channel()
-                    if ch is None:
+                    if ch is None and not self._pong_pending:
                         self._send_cv.wait(self._flush_throttle)
                         ch = self._pick_channel()
+                    pongs, self._pong_pending = self._pong_pending, 0
                     if ch is not None:
                         chunk, eof = ch.next_packet()
                         ch.recently_sent += len(chunk)
                         self._send_cv.notify()
                     else:
                         chunk = None
+                # all writes happen on this thread: concurrent writes from
+                # the recv routine would interleave SecretConnection frame
+                # sequence numbers and fail the peer's MAC check
+                for _ in range(pongs):
+                    self.conn.write(struct.pack(">B", PKT_PONG))
                 if chunk is not None:
                     pkt = struct.pack(
                         ">BBBH", PKT_MSG, ch.desc.id,
@@ -228,7 +235,9 @@ class MConnection:
                 t = struct.unpack(
                     ">B", self.conn.read_exact(1))[0]
                 if t == PKT_PING:
-                    self.conn.write(struct.pack(">B", PKT_PONG))
+                    with self._send_cv:
+                        self._pong_pending += 1
+                        self._send_cv.notify()
                     continue
                 if t == PKT_PONG:
                     continue
