@@ -107,7 +107,8 @@ class MConnection:
         self._ping_interval = ping_interval
         self._flush_throttle = flush_throttle
         self._send_cv = threading.Condition()
-        self._pong_pending = 0      # PONGs owed; written by the send routine
+        self._pong_pending = 0   # PONGs owed; recv routine increments under
+        #                          _send_cv, send routine drains and writes
         self._stopped = threading.Event()
         self._errored = False
         self._err_lock = threading.Lock()

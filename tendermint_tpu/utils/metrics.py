@@ -86,7 +86,7 @@ class Registry:
         self.txs_committed = Counter()
         self.rounds_started = Counter()
         # crypto plane
-        self.sigs_verified = Counter()        # lanes checked (incl. padding)
+        self.sigs_verified = Counter()        # signatures that PASSED
         self.sigs_requested = Counter()       # real signatures asked for
         self.verify_batches = Counter()
         self.batch_occupancy = Summary()      # real/padded per batch
@@ -107,7 +107,7 @@ class Registry:
             "txs_committed": self.txs_committed.value,
             "rounds_started": self.rounds_started.value,
             "sigs_requested": self.sigs_requested.value,
-            "sigs_verified_lanes": self.sigs_verified.value,
+            "sigs_verified": self.sigs_verified.value,
             "sigs_per_sec": round(self.sigs_requested.value / up, 1),
             "verify_batches": self.verify_batches.value,
             "batch_occupancy_mean": round(self.batch_occupancy.mean, 4),
