@@ -34,9 +34,13 @@ def log(msg: str) -> None:
 # fixture construction
 # ---------------------------------------------------------------------------
 
-def _sign_batch_fixture(n_vals: int, n_sigs: int):
-    """(pubs, msgs, sigs) uint8 arrays: n_sigs votes across n_vals keys."""
+def _sign_batch_fixture(n_vals: int, n_sigs: int, h0: int = 1):
+    """(pubs, msgs, sigs, val_pubs, val_idx) uint8/int32 arrays:
+    n_sigs votes across n_vals keys (lane i signed by key val_idx[i]).
+    h0 offsets the vote heights so distinct fixtures can defeat any
+    result caching between identical repeated calls."""
     import numpy as np
+    from concurrent.futures import ThreadPoolExecutor
     from tendermint_tpu.crypto import native
     from tendermint_tpu.crypto import pure_ed25519 as ref
     from tendermint_tpu.types import canonical
@@ -44,20 +48,26 @@ def _sign_batch_fixture(n_vals: int, n_sigs: int):
     seeds = [bytes([1 + (i % 250), 2 + (i // 250)]) + b"\x00" * 30
              for i in range(n_vals)]
     pubs_by_val = [ref.pubkey_from_seed(s) for s in seeds]
-    pubs, msgs, sigs = [], [], []
+    pubs, msgs = [], []
     for i in range(n_sigs):
         v = i % n_vals
-        h = 1 + i // n_vals
+        h = h0 + i // n_vals
         msg = canonical.sign_bytes("bench-chain", canonical.TYPE_PRECOMMIT,
                                    h, 0, block_hash=b"\x11" * 32,
                                    parts_hash=b"\x22" * 32, parts_total=2)
         pubs.append(pubs_by_val[v])
         msgs.append(msg)
-        sigs.append(sign(seeds[v], msg))
+    with ThreadPoolExecutor(8) as pool:     # native signing releases the GIL
+        sigs = list(pool.map(
+            lambda i: sign(seeds[i % n_vals], msgs[i]), range(n_sigs),
+            chunksize=max(1, n_sigs // 32)))
     return (np.frombuffer(b"".join(pubs), np.uint8).reshape(n_sigs, 32),
             np.frombuffer(b"".join(msgs), np.uint8).reshape(
                 n_sigs, canonical.SIGN_BYTES_LEN),
-            np.frombuffer(b"".join(sigs), np.uint8).reshape(n_sigs, 64))
+            np.frombuffer(b"".join(sigs), np.uint8).reshape(n_sigs, 64),
+            np.frombuffer(b"".join(pubs_by_val), np.uint8).reshape(
+                n_vals, 32),
+            (np.arange(n_sigs) % n_vals).astype(np.int32))
 
 
 def _build_bench_chain(n_vals: int, n_blocks: int, txs_per_block: int = 1):
@@ -84,13 +94,13 @@ def native_scalar_rate(n: int = 1500) -> float:
     if not native.AVAILABLE:
         log("native backend unavailable; anchoring against bigint python")
         from tendermint_tpu.crypto import pure_ed25519 as ref
-        pubs, msgs, sigs = _sign_batch_fixture(4, 50)
+        pubs, msgs, sigs, _, _ = _sign_batch_fixture(4, 50)
         t0 = time.perf_counter()
         for i in range(50):
             ref.verify(pubs[i].tobytes(), msgs[i].tobytes(),
                        sigs[i].tobytes())
         return 50 / (time.perf_counter() - t0)
-    pubs, msgs, sigs = _sign_batch_fixture(4, n)
+    pubs, msgs, sigs, _, _ = _sign_batch_fixture(4, n)
     rows = [(pubs[i].tobytes(), msgs[i].tobytes(), sigs[i].tobytes())
             for i in range(n)]
     t0 = time.perf_counter()
@@ -130,7 +140,9 @@ def config3_fastsync_cpu_anchor(n_blocks: int) -> dict:
 
 
 def config1_batch_verify(quick: bool, sizes=None) -> dict:
-    """One big device verify call (the vmap grid)."""
+    """One big device verify call against a fixed 100-validator key set —
+    the grouped kernel with cached comb tables, BASELINE.md's "100-validator
+    VoteSet batch" workload."""
     import numpy as np
     from tendermint_tpu.crypto import backend as cb
     sizes = sizes or ([4096] if quick else [65536, 32768, 16384])
@@ -138,23 +150,51 @@ def config1_batch_verify(quick: bool, sizes=None) -> dict:
     last_err = None
     for n in sizes:
         try:
-            log(f"[config1] signing {n} fixtures...")
-            pubs, msgs, sigs = _sign_batch_fixture(100, n)
-            log(f"[config1] compiling + first call @ {n}...")
+            import jax.numpy as jnp
+            log(f"[config1] signing 2x{n} fixtures...")
+            batches = [_sign_batch_fixture(100, n, h0=1 + r * n)
+                       for r in range(2)]    # distinct: defeats any caching
+            set_key = b"bench-config1-100"
+            val_pubs, val_idx = batches[0][3], batches[0][4]
+            log(f"[config1] table build + compile + first call @ {n}...")
             t0 = time.perf_counter()
-            ok = backend.verify_batch(pubs, msgs, sigs)
+            ok = backend.verify_grouped(set_key, val_pubs, val_idx,
+                                        batches[0][1], batches[0][2])
             compile_s = time.perf_counter() - t0
             if not ok.all():
                 raise RuntimeError("verify returned invalid lanes")
-            reps = 3
-            t0 = time.perf_counter()
-            for _ in range(reps):
-                ok = backend.verify_batch(pubs, msgs, sigs)
+            # full path: host arrays in, host bools out (includes the
+            # host<->device transfer a node pays)
+            reps, t0 = 4, time.perf_counter()
+            for r in range(reps):
+                _, msgs, sigs, _, _ = batches[r % 2]
+                ok = backend.verify_grouped(set_key, val_pubs, val_idx,
+                                            msgs, sigs)
             steady = (time.perf_counter() - t0) / reps
-            rate = n / steady
-            log(f"[config1] n={n} compile+first={compile_s:.1f}s "
-                f"steady={steady:.3f}s rate={rate:.0f} sigs/s")
-            return {"config": 1, "sigs_per_sec": rate, "batch": n,
+            # device-resident: inputs staged (as when the batch is already
+            # on device from the pipeline's previous stage) — the raw
+            # batch-verify throughput this config is defined to measure
+            tbl, pub_ok, _ = backend._set_tables(set_key, val_pubs)
+            staged = [
+                tuple(map(jnp.asarray, (val_idx, val_pubs[val_idx],
+                                        b[1], b[2])))
+                for b in batches]
+            import numpy as _np
+            _np.asarray(backend._dev.verify_grouped_jit(
+                tbl, pub_ok, *staged[0]))
+            t0 = time.perf_counter()
+            for r in range(reps):
+                out = _np.asarray(backend._dev.verify_grouped_jit(
+                    tbl, pub_ok, *staged[r % 2]))
+            dev_steady = (time.perf_counter() - t0) / reps
+            if not out.all():
+                raise RuntimeError("device verify returned invalid lanes")
+            rate, dev_rate = n / steady, n / dev_steady
+            log(f"[config1] n={n} build+compile+first={compile_s:.1f}s "
+                f"steady={steady:.3f}s rate={rate:.0f} sigs/s "
+                f"(device-resident {dev_rate:.0f} sigs/s)")
+            return {"config": 1, "sigs_per_sec": rate,
+                    "device_sigs_per_sec": dev_rate, "batch": n,
                     "first_call_seconds": compile_s}
         except Exception as e:          # OOM/compile failure: try smaller
             last_err = e
@@ -163,33 +203,44 @@ def config1_batch_verify(quick: bool, sizes=None) -> dict:
 
 
 def config2_merkle_batch(quick: bool) -> dict:
-    """Batched SHA-256 tree roots: B blocks x T tx-leaves."""
+    """Batched SHA-256 tree roots: B blocks x T tx-leaves.
+
+    Inputs are staged on device outside the timed loop (in the replay
+    pipeline the leaf data is already device-resident from the verify
+    stage; re-uploading each rep would measure the dev-tunnel's copy
+    bandwidth, not the kernel).  Distinct batches per rep defeat any
+    transport-level result caching.
+    """
     import numpy as np
     from tendermint_tpu.ops import merkle as dev_merkle
     from tendermint_tpu.types import merkle as host_merkle
     import jax
+    import jax.numpy as jnp
     B, T, L = (256, 128, 64) if quick else (2048, 1024, 64)
-    leaves = np.random.default_rng(0).integers(
-        0, 256, (B, T, L), dtype=np.uint8)
+    rng = np.random.default_rng(0)
+    host_batches = [rng.integers(0, 256, (B, T, L), dtype=np.uint8)
+                    for _ in range(3)]
     fn = jax.jit(dev_merkle.roots)
     log(f"[config2] compiling merkle roots for {B}x{T} trees...")
+    staged = [jnp.asarray(b) for b in host_batches]
     t0 = time.perf_counter()
-    roots = np.asarray(fn(leaves))
+    roots = np.asarray(fn(staged[0]))
     compile_s = time.perf_counter() - t0
     want = host_merkle.root_from_leaf_hashes(
-        [host_merkle.leaf_hash(leaves[0, i].tobytes()) for i in range(T)])
+        [host_merkle.leaf_hash(host_batches[0][0, i].tobytes())
+         for i in range(T)])
     assert roots[0].tobytes() == want, "device merkle root mismatch"
     reps = 3
     t0 = time.perf_counter()
-    for _ in range(reps):
-        roots = np.asarray(fn(leaves))
+    for r in range(reps):
+        roots = np.asarray(fn(staged[r % len(staged)]))
     steady = (time.perf_counter() - t0) / reps
     # host anchor: C-speed hashlib tree over the same data (sampled)
     sample = min(B, 64)
     t0 = time.perf_counter()
     for b in range(sample):
         host_merkle.root_from_leaf_hashes(
-            [host_merkle.leaf_hash(leaves[b, i].tobytes())
+            [host_merkle.leaf_hash(host_batches[0][b, i].tobytes())
              for i in range(T)])
     host_rate = sample / (time.perf_counter() - t0)
     rate = B / steady
@@ -201,15 +252,27 @@ def config2_merkle_batch(quick: bool) -> dict:
 
 def _replay_chain(n_vals: int, n_blocks: int, backend: str,
                   window: int | None = None,
-                  target_lanes: int = 16384) -> dict:
+                  target_lanes: int = 32768) -> dict:
     """Shared replay pipeline: batched commit verify + part re-hash +
-    apply, identical to BlockchainReactor._sync_step minus networking."""
+    apply, identical to BlockchainReactor._sync_step minus networking.
+
+    Three-stage pipeline over windows: a prep thread re-hashes part sets
+    and assembles verify lanes for window k+2, a verify thread runs the
+    device batch for window k+1, and the main thread applies window k —
+    host packing, device verification, and host ABCI/store work all
+    overlap (the reactor's verify-ahead sync loop, widened one stage), so
+    throughput is max(stage) instead of their sum.
+    """
+    import queue as _queue
+    import threading
+    import numpy as np
     from tendermint_tpu.crypto import backend as cb
     from tendermint_tpu.state import execution
     from tendermint_tpu.state.state import get_state
     from tendermint_tpu.proxy import ClientCreator
     from tendermint_tpu.types import BlockID
-    from tendermint_tpu.types.validator import verify_commits_batched
+    from tendermint_tpu.types.validator import (CommitPowerError,
+                                                CommitSignatureError)
     from tendermint_tpu.utils.db import MemDB
 
     if window is None:
@@ -222,47 +285,185 @@ def _replay_chain(n_vals: int, n_blocks: int, backend: str,
     conns = ClientCreator("kvstore").new_app_conns()
     total_sigs = 0
     log(f"[replay] replaying on backend={backend} window={window}...")
-    # warm-up: compile the verify graph for this window's bucket outside
-    # the timed region (a real node pays this once per process, and the
-    # persistent compile cache makes restarts cheap)
-    warm = chain[:window]
-    _warm_items = []
-    for block, _, seen in warm:
-        parts = block.make_part_set()
-        _warm_items.append((BlockID(block.hash(), parts.header),
-                            block.height, seen))
-    verify_commits_batched(state.validators, state.chain_id, _warm_items)
-    t0 = time.perf_counter()
-    i = 0
-    while i < len(chain):
-        blocks = chain[i:i + window]
-        items = []
-        for j, (block, _, seen) in enumerate(blocks):
-            parts = block.make_part_set()           # re-hash like fast-sync
+    # the bench chain has a fixed validator set, so every window verifies
+    # against the genesis set (the reactor cuts windows on valset change)
+    vals = state.validators
+    chain_id = state.chain_id
+    set_key, pubs_mat = vals.set_key(), vals.pubs_matrix()
+    total_power = vals.total_voting_power()
+
+    def _prep(blocks):
+        """Stage 1: part-set re-hash + lane assembly (host)."""
+        items, arrays = [], []
+        for block, _, seen in blocks:
+            parts = block.make_part_set()       # re-hash like fast-sync
             bid = BlockID(block.hash(), parts.header)
             items.append((bid, block.height, seen, parts))
-        verify_commits_batched(
-            state.validators, state.chain_id,
-            [(bid, h, c) for bid, h, c, _ in items])
+            arrays.append(vals.commit_verify_arrays(chain_id, bid,
+                                                    block.height, seen))
+        msgs = np.concatenate([a[1] for a in arrays])
+        sigs = np.concatenate([a[2] for a in arrays])
+        idxs = np.concatenate([a[4] for a in arrays])
+        return items, arrays, msgs, sigs, idxs
+
+    def _verify(items, arrays, msgs, sigs, idxs):
+        """Stage 2: one grouped device batch + per-commit tallies."""
+        ok = cb.verify_grouped(set_key, pubs_mat, idxs, msgs, sigs)
+        off = 0
+        for (bid, h, _, _), a in zip(items, arrays):
+            n = len(a[0])
+            if not ok[off:off + n].all():
+                raise CommitSignatureError(
+                    h, int(np.argmin(ok[off:off + n])))
+            off += n
+            tallied = int(a[3].sum())
+            if not tallied * 3 > total_power * 2:
+                raise CommitPowerError(h, tallied, total_power)
+
+    # warm-up: build tables + compile the verify graph for this window's
+    # bucket outside the timed region (a real node pays this once per
+    # process, and the persistent compile cache makes restarts cheap)
+    _verify(*_prep(chain[:window]))
+
+    prep_q: _queue.Queue = _queue.Queue(maxsize=2)
+    verified_q: _queue.Queue = _queue.Queue(maxsize=2)
+    prep_seconds = [0.0]
+    verify_seconds = [0.0]
+
+    def _prep_thread():
+        try:
+            for i in range(0, len(chain), window):
+                t = time.perf_counter()
+                prepped = _prep(chain[i:i + window])
+                prep_seconds[0] += time.perf_counter() - t
+                prep_q.put(prepped)
+            prep_q.put(None)
+        except BaseException as e:
+            prep_q.put(e)
+
+    def _verify_thread():
+        try:
+            while True:
+                got = prep_q.get()
+                if got is None or isinstance(got, BaseException):
+                    verified_q.put(got)
+                    return
+                t = time.perf_counter()
+                _verify(*got)
+                verify_seconds[0] += time.perf_counter() - t
+                verified_q.put(got[0])
+        except BaseException as e:
+            verified_q.put(e)
+
+    t0 = time.perf_counter()
+    threading.Thread(target=_prep_thread, daemon=True).start()
+    threading.Thread(target=_verify_thread, daemon=True).start()
+    apply_seconds = 0.0
+    while True:
+        got = verified_q.get()
+        if got is None:
+            break
+        if isinstance(got, BaseException):
+            raise got
+        items = got
         total_sigs += sum(len(c.precommits) for _, _, c, _ in items)
-        for (block, _, seen), (bid, h, c, parts) in zip(blocks, items):
+        t = time.perf_counter()
+        for bid, h, c, parts in items:
+            block = chain[h - 1][0]
             execution.apply_block(state, None, conns.consensus, block,
                                   parts.header, execution.MockMempool(),
                                   check_last_commit=False)
-        i += window
+        apply_seconds += time.perf_counter() - t
     dt = time.perf_counter() - t0
     assert state.last_block_height == n_blocks
     out = {"blocks_per_sec": n_blocks / dt, "sigs_per_sec": total_sigs / dt,
-           "blocks": n_blocks, "validators": n_vals, "seconds": dt}
+           "blocks": n_blocks, "validators": n_vals, "seconds": dt,
+           "prep_seconds": round(prep_seconds[0], 2),
+           "verify_seconds": round(verify_seconds[0], 2),
+           "apply_seconds": round(apply_seconds, 2)}
     log(f"[replay] backend={backend}: {out['blocks_per_sec']:.1f} blocks/s "
-        f"{out['sigs_per_sec']:.0f} sigs/s over {dt:.1f}s")
+        f"{out['sigs_per_sec']:.0f} sigs/s over {dt:.1f}s "
+        f"(prep {out['prep_seconds']}s verify {out['verify_seconds']}s "
+        f"apply {out['apply_seconds']}s)")
+    return out
+
+
+def config4_light_multichain(quick: bool) -> dict:
+    """Light-client grid: header+commit pairs for 8 independent chains,
+    each verified through the grouped kernel against that chain's cached
+    comb tables (BASELINE config 4)."""
+    import numpy as np
+    from concurrent.futures import ThreadPoolExecutor
+    from tendermint_tpu.crypto import backend as cb
+    from tendermint_tpu.crypto import native
+    from tendermint_tpu.crypto import pure_ed25519 as ref
+    from tendermint_tpu.light import ChainBatch, verify_chains_batched
+    from tendermint_tpu.types import canonical
+    from tendermint_tpu.types.block import BlockID, Commit
+    from tendermint_tpu.types.part_set import PartSetHeader
+    from tendermint_tpu.types.validator import Validator, ValidatorSet
+    from tendermint_tpu.types.vote import Vote
+    from tendermint_tpu.types.keys import PrivKey
+    from tendermint_tpu.types.priv_validator import PrivValidator
+
+    n_chains, H, V = (8, 256, 4) if quick else (8, 8192, 8)
+    backend = cb.set_backend("tpu")
+    sign = native.sign_one if native.AVAILABLE else ref.sign
+    rng = np.random.default_rng(4)
+    chains = []
+    log(f"[config4] building {n_chains} chains x {H} headers x {V} vals...")
+    with ThreadPoolExecutor(8) as pool:
+        for c in range(n_chains):
+            cid = f"light-{c}"
+            seeds = [bytes([c + 1, i + 1]) + b"\x00" * 30 for i in range(V)]
+            privs = [PrivValidator(PrivKey(s)) for s in seeds]
+            vs = ValidatorSet([Validator(p.pub_key, 10) for p in privs])
+            by_addr = {p.address: p for p in privs}
+            ordered = [by_addr[v.address] for v in vs.validators]
+            items = []
+            hashes = rng.integers(0, 256, (H, 2, 32), dtype=np.uint8)
+            for h in range(1, H + 1):
+                bid = BlockID(hashes[h - 1, 0].tobytes(),
+                              PartSetHeader(1, hashes[h - 1, 1].tobytes()))
+                votes = [Vote(validator_address=p.address,
+                              validator_index=i, height=h, round=0,
+                              type=canonical.TYPE_PRECOMMIT, block_id=bid)
+                         for i, p in enumerate(ordered)]
+                sigs = pool.map(
+                    lambda pv: sign(pv[1].priv_key.seed,
+                                    pv[0].sign_bytes(cid)),
+                    zip(votes, ordered))
+                signed = [Vote(**{**v.__dict__, "signature": s})
+                          for v, s in zip(votes, sigs)]
+                items.append((bid, h,
+                              Commit(block_id=bid, precommits=signed)))
+            chains.append(ChainBatch(cid, vs, items))
+    log("[config4] warm-up (tables + compiles)...")
+    warm = [ChainBatch(cb_.chain_id, cb_.validators, cb_.items[:])
+            for cb_ in chains]
+    t0 = time.perf_counter()
+    verify_chains_batched(warm)
+    first = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    verify_chains_batched(chains)
+    dt = time.perf_counter() - t0
+    pairs = n_chains * H
+    out = {"config": 4, "pairs_per_sec": pairs / dt,
+           "sigs_per_sec": pairs * V / dt, "chains": n_chains,
+           "headers_per_chain": H, "validators": V,
+           "first_pass_seconds": round(first, 1), "seconds": round(dt, 2)}
+    log(f"[config4] {pairs} pairs over {n_chains} chains: "
+        f"{out['pairs_per_sec']:.0f} pairs/s {out['sigs_per_sec']:.0f} "
+        f"sigs/s (first pass {first:.1f}s)")
     return out
 
 
 def config3_fastsync(quick: bool) -> dict:
     """North star: pipelined replay with batched device verification,
     100 validators, vs the same pipeline on the scalar CPU backend."""
-    n_blocks = 326 if quick else 978    # multiples of the 163-block window
+    # enough windows that pipeline fill/drain amortizes: 20 windows of 327
+    # blocks (32768-lane bucket) steady-state the three stages
+    n_blocks = 326 if quick else 6540
     res = _replay_chain(n_vals=100, n_blocks=n_blocks, backend="tpu")
     anchor = config3_fastsync_cpu_anchor(64 if quick else 128)
     res["cpu_pipeline_sigs_per_sec"] = anchor["sigs_per_sec"]
@@ -286,9 +487,10 @@ def main() -> None:
     results["native_scalar_sigs_per_sec"] = anchor
 
     configs = {0: config0_cpu_replay, 1: config1_batch_verify,
-               2: config2_merkle_batch, 3: config3_fastsync}
+               2: config2_merkle_batch, 3: config3_fastsync,
+               4: config4_light_multichain}
     run = ([args.config] if args.config is not None
-           else ([1, 3] if args.quick else [0, 1, 2, 3]))
+           else ([1, 3] if args.quick else [0, 1, 2, 3, 4]))
     for c in run:
         try:
             results[f"config{c}"] = configs[c](args.quick)

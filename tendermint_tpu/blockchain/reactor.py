@@ -25,6 +25,7 @@ from tendermint_tpu.p2p.peer import Peer, Reactor
 from tendermint_tpu.p2p.types import ChannelDescriptor
 from tendermint_tpu.state import execution
 from tendermint_tpu.types import BlockID
+from tendermint_tpu.types.part_set import from_data_batched
 from tendermint_tpu.types.validator import (CommitPowerError,
                                             CommitSignatureError,
                                             verify_commits_batched)
@@ -180,11 +181,14 @@ class BlockchainReactor(Reactor):
             self.pool.redo(window[0].height)
             return False
         window = window[:cut]
-        parts_list, items = [], []
+        # re-hash the whole window's part sets in one device batch (full
+        # 64KB chunks lockstep on device, tails + trees on host) — proving
+        # data integrity like the reference's per-block re-hash
+        # (`blockchain/reactor.go:224`) at batch rates
+        parts_list = from_data_batched([b.encode() for b in window])
+        items = []
         for i, b in enumerate(window):
-            parts = b.make_part_set()     # re-hash, proving data integrity
-            bid = BlockID(b.hash(), parts.header)
-            parts_list.append(parts)
+            bid = BlockID(b.hash(), parts_list[i].header)
             items.append((bid, b.height, blocks[i + 1].last_commit))
         t0 = time.perf_counter()
         try:

@@ -17,12 +17,19 @@ import shutil
 import sys
 
 from tendermint_tpu import __version__
-from tendermint_tpu.config import Config
+from tendermint_tpu.config import (Config, config_file, load_config_file,
+                                   save_config_file)
 
 
 def _load_config(args) -> Config:
     cfg = Config()
     cfg.base.home = args.home
+    # config.toml (written by init/testnet) is the base layer; explicit
+    # CLI flags below override it (reference: viper file + flag binding)
+    cf = config_file(os.path.expanduser(args.home))
+    if os.path.exists(cf):
+        cfg = load_config_file(cf, cfg)
+        cfg.base.home = args.home
     if getattr(args, "proxy_app", None):
         cfg.base.proxy_app = args.proxy_app
     if getattr(args, "chain_id", None):
@@ -58,6 +65,10 @@ def cmd_init(args) -> int:
         print(f"genesis written to {gen_file}")
     else:
         print(f"genesis already exists at {gen_file}")
+    cf = config_file(root)
+    if not os.path.exists(cf):
+        save_config_file(cfg, cf)
+        print(f"config written to {cf}")
     print(f"priv validator at {pv_file} ({pv.address.hex()})")
     return 0
 
@@ -102,7 +113,17 @@ def cmd_testnet(args) -> int:
         chain_id=args.chain_id or "testnet-chain",
         validators=[GenesisValidator(pv.pub_key.bytes_, 10) for pv in pvs])
     for i in range(n):
-        doc.save(os.path.join(out, f"node{i}", "genesis.json"))
+        home = os.path.join(out, f"node{i}")
+        doc.save(os.path.join(home, "genesis.json"))
+        # per-node config file: distinct ports, peers pointed at node0
+        cfg = Config()
+        cfg.base.home = home
+        cfg.base.moniker = f"node{i}"
+        cfg.rpc.laddr = f"tcp://0.0.0.0:{26657 + 2 * i}"
+        cfg.p2p.laddr = f"tcp://0.0.0.0:{26656 + 2 * i}"
+        if i > 0:
+            cfg.p2p.persistent_peers = [f"127.0.0.1:{26656}"]
+        save_config_file(cfg, config_file(home))
     print(f"wrote {n} node homes under {out}")
     return 0
 

@@ -121,3 +121,67 @@ def test_config() -> Config:
     c.consensus.timeout_commit = 0.02
     c.consensus.skip_timeout_commit = True
     return c
+
+
+# --- config file (TOML; reference config/toml.go + viper binding) ---------
+
+_SECTIONS = ("base", "rpc", "p2p", "mempool", "consensus")
+
+
+def config_file(root: str) -> str:
+    return os.path.join(root, "config.toml")
+
+
+def save_config_file(cfg: Config, path: str) -> None:
+    """Write the full config as TOML so a testnet ships one file per node
+    (reference `config/toml.go` writes config.toml at init)."""
+    def fmt(v) -> str:
+        if isinstance(v, bool):
+            return "true" if v else "false"
+        if isinstance(v, (int, float)):
+            return repr(v)
+        if isinstance(v, list):
+            return "[" + ", ".join(fmt(x) for x in v) + "]"
+        return '"' + str(v).replace("\\", "\\\\").replace('"', '\\"') + '"'
+
+    lines = ["# tendermint_tpu configuration (TOML)", ""]
+    for sec in _SECTIONS:
+        lines.append(f"[{sec}]")
+        obj = getattr(cfg, sec)
+        for k, v in vars(obj).items():
+            lines.append(f"{k} = {fmt(v)}")
+        lines.append("")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write("\n".join(lines))
+    os.replace(tmp, path)
+
+
+def load_config_file(path: str, cfg: Config | None = None) -> Config:
+    """Overlay a TOML config file onto defaults.  Unknown keys fail loudly
+    (a typo silently reverting to a default is how testnets lose nights)."""
+    import tomllib
+    cfg = cfg or Config()
+    with open(path, "rb") as f:
+        data = tomllib.load(f)
+    for sec, kv in data.items():
+        if sec not in _SECTIONS:
+            raise ValueError(f"unknown config section [{sec}] in {path}")
+        obj = getattr(cfg, sec)
+        for k, v in kv.items():
+            if not hasattr(obj, k):
+                raise ValueError(f"unknown config key {sec}.{k} in {path}")
+            cur = getattr(obj, k)
+            if isinstance(cur, float) and isinstance(v, int) \
+                    and not isinstance(v, bool):
+                v = float(v)
+            if isinstance(v, bool) and not isinstance(cur, bool):
+                raise ValueError(     # bool IS an int in Python; reject
+                    f"config key {sec}.{k}: expected "
+                    f"{type(cur).__name__}, got bool")
+            if cur is not None and not isinstance(v, type(cur)):
+                raise ValueError(
+                    f"config key {sec}.{k}: expected "
+                    f"{type(cur).__name__}, got {type(v).__name__}")
+            setattr(obj, k, v)
+    return cfg

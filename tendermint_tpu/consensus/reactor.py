@@ -66,6 +66,27 @@ class PeerState:
         self.prs = PeerRoundState()
         self._lock = threading.RLock()
 
+    def summary(self) -> dict:
+        """Peer round state for dump_consensus_state (reference dumps
+        PeerRoundStates alongside the RoundState, `rpc/core/routes.go:21`)."""
+        from tendermint_tpu.utils.fmt import bits_str as bits
+        with self._lock:
+            prs = self.prs
+            return {
+                "height": prs.height, "round": prs.round, "step": prs.step,
+                "proposal": prs.proposal,
+                "proposal_block_parts": bits(prs.proposal_block_parts),
+                "proposal_pol_round": prs.proposal_pol_round,
+                "proposal_pol": bits(prs.proposal_pol),
+                "prevotes": {r: bits(b) for r, b in prs.prevotes.items()},
+                "precommits": {r: bits(b)
+                               for r, b in prs.precommits.items()},
+                "last_commit_round": prs.last_commit_round,
+                "last_commit": bits(prs.last_commit),
+                "catchup_commit_round": prs.catchup_commit_round,
+                "catchup_commit": bits(prs.catchup_commit),
+            }
+
     # -- applying peer messages ----------------------------------------
     def apply_new_round_step(self, msg: M.NewRoundStepMessage) -> None:
         with self._lock:

@@ -209,6 +209,38 @@ class ConsensusState:
                 "start_time": self.start_time,
             }
 
+    def get_round_state_dump(self) -> dict:
+        """Full RoundState for `dump_consensus_state` (reference
+        `rpc/core/routes.go:21` dumps RoundState + peer round states):
+        the summary plus per-round vote bit-arrays and the valset."""
+        from tendermint_tpu.utils.fmt import bits_str as bits
+        with self._mtx:
+            out = self.get_round_state_summary()
+            hvs = self.votes
+            votes = {}
+            if hvs is not None:
+                for r in range(self.round + 1):
+                    pv, pc = hvs.prevotes(r), hvs.precommits(r)
+                    votes[r] = {
+                        "prevotes": str(pv) if pv else None,
+                        "prevotes_bits": bits(pv.bit_array()
+                                              if pv else None),
+                        "precommits": str(pc) if pc else None,
+                        "precommits_bits": bits(pc.bit_array()
+                                                if pc else None),
+                    }
+            out["votes"] = votes
+            out["validators"] = {
+                "size": self.validators.size(),
+                "total_power": self.validators.total_voting_power(),
+                "proposer": self.validators.proposer.address.hex()
+                if self.validators.validators else None,
+            }
+            lc = self.last_commit
+            out["last_commit"] = (bits(lc.bit_array())
+                                  if lc is not None else None)
+            return out
+
     def is_proposer(self) -> bool:
         return (self.priv_validator is not None and
                 self.validators.proposer.address ==

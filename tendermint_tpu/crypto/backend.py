@@ -36,6 +36,17 @@ class Backend(Protocol):
         -> bool[N]."""
         ...
 
+    def verify_grouped(self, set_key: bytes, val_pubs: np.ndarray,
+                       val_idx: np.ndarray, msgs: np.ndarray,
+                       sigs: np.ndarray) -> np.ndarray:
+        """Verify N signatures made by members of a FIXED key set: lane i
+        was signed by val_pubs[val_idx[i]].  set_key identifies the set
+        (e.g. the validator-set hash) so device backends can cache
+        per-set precomputation (comb tables) across calls — fast-sync
+        verifies thousands of commits against the same ~100 keys.
+        Semantics identical to verify_batch(val_pubs[val_idx], ...)."""
+        ...
+
 
 def _bucket(n: int) -> int:
     b = MIN_BUCKET
@@ -57,6 +68,9 @@ class PythonBackend:
         REGISTRY.sigs_verified.inc(int(out.sum()))
         return out
 
+    def verify_grouped(self, set_key, val_pubs, val_idx, msgs, sigs):
+        return self.verify_batch(val_pubs[val_idx], msgs, sigs)
+
 
 class TpuBackend:
     """JAX batch kernel (`tendermint_tpu.ops.ed25519`) with shape bucketing.
@@ -66,6 +80,11 @@ class TpuBackend:
     """
     name = "tpu"
 
+    # Cached comb tables are ~0.8 MB per validator (uint8) — 8 sets of
+    # 128 validators is ~0.8 GB of HBM; plenty for a node following one
+    # chain plus a light client tracking a handful of others.
+    TABLE_CACHE_SETS = 8
+
     def __init__(self):
         # import lazily so the python backend works without jax configured
         import jax.numpy as jnp
@@ -73,6 +92,9 @@ class TpuBackend:
         _enable_compile_cache()
         self._jnp = jnp
         self._dev = dev
+        self._tables: dict[bytes, tuple] = {}   # set_key -> (tbl, ok, V)
+        self._tables_lock = threading.Lock()
+        self._builds: dict[bytes, threading.Event] = {}  # in-flight builds
 
     def verify_batch(self, pubkeys, msgs, sigs):
         n = len(pubkeys)
@@ -88,6 +110,91 @@ class TpuBackend:
         t0 = time.perf_counter()
         out = self._dev.verify_batch(jnp.asarray(pubkeys), jnp.asarray(msgs),
                                      jnp.asarray(sigs))
+        out = np.asarray(out)
+        REGISTRY.device_step_seconds.observe(time.perf_counter() - t0)
+        REGISTRY.sigs_requested.inc(n)
+        REGISTRY.sigs_verified.inc(int(out[:n].sum()))
+        REGISTRY.verify_batches.inc()
+        REGISTRY.batch_occupancy.observe(n / b)
+        return out[:n]
+
+    def _set_tables(self, set_key: bytes, val_pubs: np.ndarray) -> tuple:
+        """Build (or fetch) the affine comb tables for a key set.  The
+        valset is padded to a power-of-two so a handful of table shapes
+        cover any set size with one compile each.  Concurrent first
+        requests for the same set wait on one in-flight build instead of
+        each paying the multi-second device build."""
+        while True:
+            with self._tables_lock:
+                ent = self._tables.get(set_key)
+                if ent is not None:
+                    return ent
+                pending = self._builds.get(set_key)
+                if pending is None:
+                    self._builds[set_key] = threading.Event()
+                    break                    # we build
+            pending.wait()                   # someone else is building
+        try:
+            ent = self._build_tables(set_key, val_pubs)
+        finally:
+            with self._tables_lock:
+                self._builds.pop(set_key).set()
+        return ent
+
+    def _build_tables(self, set_key: bytes, val_pubs: np.ndarray) -> tuple:
+        v = len(val_pubs)
+        vb = _bucket(v)
+        if vb > v:
+            val_pubs = np.concatenate(
+                [val_pubs, np.repeat(val_pubs[:1], vb - v, 0)])
+        t0 = time.perf_counter()
+        tbl, ok = self._dev.build_neg_comb_jit(self._jnp.asarray(val_pubs))
+        tbl.block_until_ready()
+        REGISTRY.table_build_seconds.observe(time.perf_counter() - t0)
+        ent = (tbl, ok, v)
+        with self._tables_lock:
+            while len(self._tables) >= self.TABLE_CACHE_SETS:
+                self._tables.pop(next(iter(self._tables)))
+            self._tables[set_key] = ent
+        return ent
+
+    def precompile(self, set_key: bytes, val_pubs: np.ndarray,
+                   lane_buckets: list[int], msg_len: int) -> None:
+        """Warm the comb tables for a validator set and the verify
+        executables for the standard lane buckets — a cold node joining a
+        net must not stall for a minute of XLA compile on its first
+        commit (the compiles also land in the persistent cache).  Run it
+        from a background thread at boot; every call is harmless dummy
+        work through the real entry points."""
+        n_vals = len(val_pubs)
+        for n in lane_buckets:
+            idx = (np.arange(n) % n_vals).astype(np.int32)
+            msgs = np.zeros((n, msg_len), dtype=np.uint8)
+            sigs = np.zeros((n, 64), dtype=np.uint8)
+            self.verify_grouped(set_key, val_pubs, idx, msgs, sigs)
+
+    def verify_grouped(self, set_key, val_pubs, val_idx, msgs, sigs):
+        n = len(val_idx)
+        if n == 0:
+            return np.zeros(0, dtype=bool)
+        tbl, pub_ok, v = self._set_tables(set_key, val_pubs)
+        if v != len(val_pubs):       # stale key reuse would verify against
+            raise ValueError(        # the wrong table — refuse loudly
+                f"set_key reused for a different set size ({v} != "
+                f"{len(val_pubs)})")
+        pubkeys = val_pubs[val_idx]              # challenge hash input
+        b = _bucket(n)
+        pad = b - n
+        if pad:
+            val_idx = np.concatenate([val_idx, np.repeat(val_idx[:1], pad)])
+            pubkeys = np.concatenate([pubkeys, np.repeat(pubkeys[:1], pad, 0)])
+            msgs = np.concatenate([msgs, np.repeat(msgs[:1], pad, 0)])
+            sigs = np.concatenate([sigs, np.repeat(sigs[:1], pad, 0)])
+        jnp = self._jnp
+        t0 = time.perf_counter()
+        out = self._dev.verify_grouped_jit(
+            tbl, pub_ok, jnp.asarray(val_idx.astype(np.int32)),
+            jnp.asarray(pubkeys), jnp.asarray(msgs), jnp.asarray(sigs))
         out = np.asarray(out)
         REGISTRY.device_step_seconds.observe(time.perf_counter() - t0)
         REGISTRY.sigs_requested.inc(n)
@@ -169,3 +276,14 @@ def get_backend() -> Backend:
 
 def verify_batch(pubkeys, msgs, sigs) -> np.ndarray:
     return get_backend().verify_batch(pubkeys, msgs, sigs)
+
+
+def verify_grouped(set_key: bytes, val_pubs, val_idx, msgs,
+                   sigs) -> np.ndarray:
+    """Fixed-key-set verify (see Backend.verify_grouped).  Backends
+    without per-set precomputation fall back to a plain batch."""
+    be = get_backend()
+    fn = getattr(be, "verify_grouped", None)
+    if fn is None:
+        return be.verify_batch(val_pubs[val_idx], msgs, sigs)
+    return fn(set_key, val_pubs, val_idx, msgs, sigs)

@@ -72,3 +72,8 @@ class NativeBackend:
         REGISTRY.sigs_requested.inc(n)
         REGISTRY.sigs_verified.inc(int(out.sum()))
         return out
+
+    def verify_grouped(self, set_key, val_pubs, val_idx, msgs,
+                       sigs) -> np.ndarray:
+        """No per-set precompute on CPU; gather the lane keys and batch."""
+        return self.verify_batch(val_pubs[val_idx], msgs, sigs)

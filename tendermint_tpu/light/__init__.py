@@ -1,0 +1,16 @@
+"""Light client: header-chain verification without executing blocks.
+
+Reference analog: `types/validator_set.go:268-290` (`VerifyCommitAny`,
+a stub in the reference era) plus the light-client style of following a
+chain by commits alone.  Here it is a first-class subsystem designed for
+the device: commits for MANY headers — across MANY chains — flatten into
+grouped batch verifies against per-chain cached comb tables
+(bench config 4, BASELINE.md).
+"""
+
+from tendermint_tpu.light.client import (ChainBatch, LightClient,
+                                         TrustedState, verify_chains_batched,
+                                         verify_commit_any)
+
+__all__ = ["ChainBatch", "LightClient", "TrustedState",
+           "verify_chains_batched", "verify_commit_any"]

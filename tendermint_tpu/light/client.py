@@ -1,0 +1,171 @@
+"""Light-client verification: trusted-state advancement by commits alone.
+
+A light client holds (height, header-hash, validator set) and advances by
+verifying that +2/3 of the validators it trusts signed the next header —
+no block execution, no app.  Three layers:
+
+  * `verify_commit_any` — a commit checked against BOTH an old (trusted)
+    and a new (current) validator set: +2/3 of each must have signed.
+    The reference declares this entry point but leaves it a stub
+    (reference `types/validator_set.go:268-290`); here it is implemented
+    and batched.
+  * `LightClient` — sequential trusted-state follower with valset-change
+    handling (the header commits to its valset via `validators_hash`,
+    reference `types/block.go:178-193`).
+  * `verify_chains_batched` — the device showcase: header+commit pairs
+    for MANY independent chains verified with one grouped device batch
+    per chain, comb tables cached per validator set (bench config 4:
+    1M pairs x 8 chains, BASELINE.md).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from tendermint_tpu.types.block import BlockID, Commit, Header
+from tendermint_tpu.types.validator import (CommitPowerError,
+                                            CommitSignatureError,
+                                            ValidatorSet)
+from tendermint_tpu.utils.log import get_logger
+
+log = get_logger("light")
+
+
+@dataclass(frozen=True)
+class TrustedState:
+    """What a light client believes: a header it has verified and the
+    validator set that header commits to for its NEXT height."""
+    height: int
+    header_hash: bytes
+    next_validators: ValidatorSet
+
+
+@dataclass(frozen=True)
+class SignedHeader:
+    header: Header
+    commit: Commit
+
+    def validate_basic(self) -> None:
+        if self.commit.height() != self.header.height:
+            raise ValueError(
+                f"commit height {self.commit.height()} != header height "
+                f"{self.header.height}")
+
+
+def verify_commit_any(old_set: ValidatorSet, new_set: ValidatorSet,
+                      chain_id: str, block_id: BlockID, height: int,
+                      commit: Commit) -> None:
+    """Raise unless +2/3 of old_set AND +2/3 of new_set signed block_id.
+
+    The commit's precommits are index-aligned with new_set (the set that
+    produced it); old-set power is tallied by validator ADDRESS so the
+    check survives reordering, joins, and leaves between the sets.
+    Implements what the reference stubs at
+    `types/validator_set.go:268-290`.
+    """
+    from tendermint_tpu.crypto import backend as cb
+    _, msgs, sigs, new_powers, idxs = new_set.commit_verify_arrays(
+        chain_id, block_id, height, commit)
+    ok = cb.verify_grouped(new_set.set_key(), new_set.pubs_matrix(),
+                           idxs, msgs, sigs)
+    if not ok.all():
+        raise CommitSignatureError(height, int(np.argmin(ok)))
+    new_tallied = int(new_powers.sum())
+    if not new_tallied * 3 > new_set.total_voting_power() * 2:
+        raise CommitPowerError(height, new_tallied,
+                               new_set.total_voting_power())
+    old_tallied = 0
+    for lane, idx in enumerate(idxs):
+        if new_powers[lane] == 0:     # vote for a different block
+            continue
+        old_val = old_set.get_by_address(new_set.validators[idx].address)
+        if old_val is not None:
+            old_tallied += old_val.voting_power
+    if not old_tallied * 3 > old_set.total_voting_power() * 2:
+        raise CommitPowerError(height, old_tallied,
+                               old_set.total_voting_power())
+
+
+class LightClient:
+    """Sequential trusted-state follower.
+
+    `update` advances one signed header at a time; the caller supplies the
+    header's validator set (fetched from any untrusted source — it is
+    authenticated against `header.validators_hash`).
+    """
+
+    def __init__(self, chain_id: str, trusted: TrustedState):
+        self.chain_id = chain_id
+        self.trusted = trusted
+
+    def update(self, sh: SignedHeader, validators: ValidatorSet,
+               next_validators: ValidatorSet) -> TrustedState:
+        """Verify sh against the trusted state and advance to it.
+
+        validators must hash to sh.header.validators_hash (its height's
+        set); a valset change relative to the trusted set is accepted only
+        via the two-set rule (`verify_commit_any`), so a fabricated set
+        can never take over without +2/3 of the OLD set co-signing.
+        next_validators seeds the next step (authenticated the same way
+        when IT is consumed — era headers do not commit the next set).
+        """
+        sh.validate_basic()
+        h = sh.header
+        if h.chain_id != self.chain_id:
+            raise ValueError(f"chain id {h.chain_id!r} != {self.chain_id!r}")
+        if h.height != self.trusted.height + 1:
+            raise ValueError(
+                f"non-sequential header {h.height} after trusted "
+                f"{self.trusted.height} (era client verifies sequentially)")
+        if h.validators_hash != validators.hash():
+            raise ValueError("supplied validator set does not match "
+                             "header.validators_hash")
+        if (not self.trusted.header_hash and
+                h.last_block_id.hash):
+            raise ValueError("first verified header must follow genesis")
+        if (self.trusted.header_hash and
+                h.last_block_id.hash != self.trusted.header_hash):
+            raise ValueError("header.last_block_id does not point at the "
+                             "trusted header")
+        block_id = sh.commit.block_id
+        if block_id.hash != h.hash():
+            raise ValueError("commit is not for this header")
+        trusted_set = self.trusted.next_validators
+        if trusted_set.hash() == validators.hash():
+            validators.verify_commit(self.chain_id, block_id, h.height,
+                                     sh.commit)
+        else:
+            verify_commit_any(trusted_set, validators, self.chain_id,
+                              block_id, h.height, sh.commit)
+        self.trusted = TrustedState(h.height, h.hash(), next_validators)
+        return self.trusted
+
+
+@dataclass
+class ChainBatch:
+    """One chain's slice of a multi-chain verification grid: a fixed
+    validator set and many (block_id, height, commit) items."""
+    chain_id: str
+    validators: ValidatorSet
+    items: list[tuple]        # [(BlockID, height, Commit)]
+
+
+def verify_chains_batched(chains: list[ChainBatch]) -> None:
+    """Verify MANY chains' commit batches — the multi-chain device grid.
+
+    Each chain's lanes go through the grouped kernel against that chain's
+    cached comb tables; with up to `TpuBackend.TABLE_CACHE_SETS` chains the
+    tables all stay resident, so a relay/light-client hub tracking several
+    chains pays table build once per (chain, valset) epoch.  Raises on the
+    first failing chain (error names chain and height).
+    """
+    from tendermint_tpu.types.validator import verify_commits_batched
+    for cb_ in chains:
+        try:
+            verify_commits_batched(cb_.validators, cb_.chain_id, cb_.items)
+        except (CommitSignatureError, CommitPowerError) as e:
+            log.warn("light verification failed", chain=cb_.chain_id,
+                     height=e.height)
+            raise

@@ -13,6 +13,7 @@ removal on update, safe concurrent CheckTx from RPC threads.
 
 from __future__ import annotations
 
+import os
 import threading
 from collections import OrderedDict
 
@@ -33,6 +34,7 @@ class Mempool:
         self._txs_available_cb = None
         self._wal_path = wal_path
         self._wal = open(wal_path, "ab") if wal_path else None
+        self._recovering = False
 
     # -- locking across app Commit (reference state/execution.go:248) ----
     def lock(self):
@@ -60,7 +62,7 @@ class Mempool:
                 self._cache.popitem(last=False)
             res = self.proxy.check_tx(tx)
             if res.is_ok:
-                if self._wal is not None:
+                if self._wal is not None and not self._recovering:
                     self._wal.write(len(tx).to_bytes(4, "big") + tx)
                     self._wal.flush()
                 self._txs[h] = tx
@@ -80,6 +82,40 @@ class Mempool:
         """Height-gated fire-once-per-height notification
         (reference `:99-104,277-294`)."""
         self._txs_available_cb = cb
+
+    # -- WAL recovery (SURVEY §5 checkpoint layer 5) ----------------------
+    def recover_wal(self) -> int:
+        """Re-admit journalled txs after a crash (call once at boot, after
+        the app handshake restored app state).  Entries are re-run through
+        CheckTx — txs already committed meanwhile are rejected by the app
+        or deduped by the block — and a torn tail is truncated.  Returns
+        the number of txs re-admitted."""
+        if not self._wal_path:
+            return 0
+        try:
+            with open(self._wal_path, "rb") as f:
+                data = f.read()
+        except FileNotFoundError:
+            return 0
+        txs, off = [], 0
+        while off + 4 <= len(data):
+            n = int.from_bytes(data[off:off + 4], "big")
+            if off + 4 + n > len(data):
+                break                      # torn tail from a mid-write crash
+            txs.append(data[off + 4:off + 4 + n])
+            off += 4 + n
+        readmitted = 0
+        self._recovering = True
+        try:
+            for tx in txs:
+                res = self.check_tx(tx)
+                if res is not None and res.is_ok:
+                    readmitted += 1
+        finally:
+            self._recovering = False
+        with self._lock:
+            self._rewrite_wal()
+        return readmitted
 
     # -- queries ---------------------------------------------------------
     def size(self) -> int:
@@ -117,13 +153,35 @@ class Mempool:
                 if self.proxy.check_tx(tx).is_ok:
                     survivors[h] = tx
             self._txs = survivors
+        # compact the journal to the surviving pool: committed txs must
+        # not be re-admitted (and re-EXECUTED) by a later recover_wal
+        self._rewrite_wal()
         if self._txs:
             self._notify_available()
+
+    def _rewrite_wal(self) -> None:
+        """Atomically rewrite the journal to exactly the current pool
+        (temp + rename: a crash mid-rewrite leaves the old journal, whose
+        extra entries are merely re-checked, never the empty file a
+        truncate-in-place would)."""
+        if not self._wal_path:
+            return
+        if self._wal is not None:
+            self._wal.close()
+        tmp = self._wal_path + ".tmp"
+        with open(tmp, "wb") as f:
+            for tx in self._txs.values():
+                f.write(len(tx).to_bytes(4, "big") + tx)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._wal_path)
+        self._wal = open(self._wal_path, "ab")
 
     def flush(self) -> None:
         with self._lock:
             self._txs.clear()
             self._cache.clear()
+            self._rewrite_wal()   # journal == pool, or recovery resurrects
 
     def close(self) -> None:
         if self._wal is not None:

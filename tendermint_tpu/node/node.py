@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 
 from tendermint_tpu.blockchain.store import BlockStore
 from tendermint_tpu.config import Config
@@ -74,7 +75,14 @@ class Node:
 
         # --- events, mempool, tx index, consensus (reference :96-158) ---
         self.evsw = EventSwitch()
-        self.mempool = Mempool(self.proxy_app.mempool, config.mempool)
+        mempool_wal = (os.path.join(base.db_dir(), "mempool.wal")
+                       if base.db_backend != "memdb" else "")
+        self.mempool = Mempool(self.proxy_app.mempool, config.mempool,
+                               wal_path=mempool_wal)
+        if mempool_wal:
+            n = self.mempool.recover_wal()
+            if n:
+                log.info("mempool wal recovered", txs=n)
         self.tx_indexer = (KVTxIndexer(mk("tx_index"))
                            if base.db_backend != "memdb"
                            else KVTxIndexer(new_db("memdb")))
@@ -90,8 +98,16 @@ class Node:
         self.switch = None
         self._maybe_build_p2p()
 
+        # --- background precompile of the crypto hot paths ---
+        # A cold validator joining mid-height must not stall for the
+        # first-verify XLA compile (SURVEY §5: measured ~1-2 min cold);
+        # warm the current valset's tables + standard lane buckets while
+        # the node boots.  Daemon thread: never blocks startup/shutdown.
+        self._maybe_precompile()
+
         # --- RPC ---
         self.rpc_server = None
+        self.grpc_server = None
         self._stopped = threading.Event()
 
     @property
@@ -100,6 +116,41 @@ class Node:
         commit, so RPC must read through it rather than hold the boot-time
         object."""
         return self.consensus.state
+
+    def _maybe_precompile(self) -> None:
+        from tendermint_tpu.crypto import backend as cb
+        be = cb.get_backend()
+        if not hasattr(be, "precompile"):
+            return
+        from tendermint_tpu.blockchain.reactor import DEFAULT_BATCH
+
+        def bucket(n):
+            b = cb.MIN_BUCKET
+            while b < n:
+                b *= 2
+            return b
+
+        vals = self.consensus.state.validators
+        v = max(vals.size(), 1)
+        # the lane counts this node will actually produce: a single
+        # gossiped vote (MIN_BUCKET), one commit (V lanes), and a full
+        # fast-sync verify window (DEFAULT_BATCH blocks x V lanes)
+        buckets = sorted({cb.MIN_BUCKET, bucket(v),
+                          bucket(DEFAULT_BATCH * v)})
+
+        def warm():
+            try:
+                from tendermint_tpu.types import canonical
+                t0 = time.time()
+                be.precompile(vals.set_key(), vals.pubs_matrix(), buckets,
+                              canonical.SIGN_BYTES_LEN)
+                log.info("crypto precompile done", buckets=buckets,
+                         seconds=round(time.time() - t0, 1))
+            except Exception:
+                log.exception("crypto precompile failed")
+
+        threading.Thread(target=warm, daemon=True,
+                         name="crypto-precompile").start()
 
     def _maybe_build_p2p(self) -> None:
         """Wire the p2p stack when available; solo nodes skip it
@@ -125,11 +176,22 @@ class Node:
             from tendermint_tpu.rpc.server import RPCServer
             self.rpc_server = RPCServer(self, self.config.rpc)
             self.rpc_server.start()
+        if self.config.rpc.grpc_laddr:
+            try:
+                from tendermint_tpu.rpc.grpc_server import GRPCServer
+                from tendermint_tpu.rpc.routes import Routes
+                self.grpc_server = GRPCServer(
+                    Routes(self), self.config.rpc.grpc_laddr)
+                self.grpc_server.start()
+            except ImportError:
+                log.warn("rpc.grpc_laddr set but grpcio unavailable")
 
     def stop(self) -> None:
         self._stopped.set()
         if self.rpc_server is not None:
             self.rpc_server.stop()
+        if self.grpc_server is not None:
+            self.grpc_server.stop()
         if self.switch is not None:
             self.switch.stop()
         self.consensus.stop()

@@ -155,6 +155,23 @@ def encode(Q) -> jnp.ndarray:
     return yb.at[..., 31].set(yb[..., 31] | (xb << 7).astype(jnp.uint8))
 
 
+def encode_batch(Q) -> tuple:
+    """Flat-batched encode: coords [N, 32] -> (uint8[N, 32], ok[N]).
+
+    One Montgomery batch inversion (`field.batch_inv`) replaces the
+    per-lane ~265-mul inversion ladder `encode` pays — ~5 muls/lane.
+    This is what lets the verifier check enc([s]B + [k](-A)) == R_bytes
+    instead of decompressing R per lane (~270 muls).  ok is False where
+    Z == 0 (not a projective point; garbage lanes from masked failures).
+    """
+    x, y, z, _ = Q
+    zi, nz = fe.batch_inv(z)
+    xb = fe.parity(fe.mul(x, zi))
+    yb = fe.to_bytes(fe.mul(y, zi))
+    return (yb.at[..., 31].set(yb[..., 31] | (xb << 7).astype(jnp.uint8)),
+            nz)
+
+
 # --- scalar multiplication ------------------------------------------------
 
 def _build_window_table(Q):
@@ -221,19 +238,52 @@ def build_comb_tables(Q) -> tuple:
     return rows                          # [32, 256, ..., V, 32] per coord
 
 
-def scalar_mul_comb(tbl, val_idx: jnp.ndarray, s: jnp.ndarray) -> tuple:
-    """[s] * Q_{val_idx} from comb tables.
+def comb_to_affine(tbl) -> tuple:
+    """Extended comb tables -> packed affine tables, ON DEVICE.
 
-    tbl: build_comb_tables output [32, 256, V, 32] per coord;
-    val_idx int32 [N]; s bytes/limbs [N, 32] -> point coords [N, 32].
-    32 gathered extended adds, no doublings.
+    tbl: `build_comb_tables` output, coords [32, 256, V, 32].
+    Returns (packed uint8[32, 256, V, 3, 32], ok bool[V]) where entry
+    [w, j, v] = (y+x, y-x, 2d*x*y) of j * 2^(8w) * Q_v in canonical
+    bytes — uint8 storage quarters the gather traffic of the hot loop
+    and mixed addition (`pt_add_affine`, 7 muls) replaces extended
+    addition (9 muls).  One Montgomery batch inversion normalizes all
+    32*256*V entries at once.  Identity entries (Z=1, X=0, Y=1) become
+    (1, 1, 0) — exactly `pt_add_affine`'s no-op entry, so digit 0 needs
+    no special case.  ok[v] is False if any entry of validator v failed
+    to normalize (garbage chains from an invalid input point).
     """
+    x, y, z, _ = tbl
+    shape = z.shape                                  # [32, 256, V, 32]
+    zi, nz = fe.batch_inv(z.reshape(-1, fe.NLIMBS))
+    zi = zi.reshape(shape)
+    xa, ya = fe.mul(x, zi), fe.mul(y, zi)
+    rows = jnp.stack([
+        fe.to_bytes(fe.add(ya, xa)),
+        fe.to_bytes(fe.sub(ya, xa)),
+        fe.to_bytes(fe.mul(fe.mul(xa, ya), jnp.asarray(_D2))),
+    ], axis=-2)                                      # [32, 256, V, 3, 32]
+    ok = jnp.all(nz.reshape(shape[:-1]), axis=(0, 1))
+    return rows, ok
+
+
+def scalar_mul_comb(tbl: jnp.ndarray, val_idx: jnp.ndarray,
+                    s: jnp.ndarray) -> tuple:
+    """[s] * Q_{val_idx} from packed affine comb tables.
+
+    tbl: `comb_to_affine` output uint8[32, 256, V, 3, 32];
+    val_idx int32 [N]; s bytes/limbs [N, 32] -> point coords [N, 32].
+    32 gathered mixed adds, no doublings: ~224 field muls per lane vs
+    ~2760 for the cold variable-base ladder in `scalar_mul`.
+    """
+    V = tbl.shape[2]
     digits = jnp.moveaxis(s.astype(jnp.int32), -1, 0)   # [32, N]
 
     def body(acc, xs):
-        digit, tw = xs                   # tw: [256, V, 32] per coord
-        sel = tuple(t[digit, val_idx] for t in tw)       # [N, 32]
-        return pt_add(acc, sel), None
+        digit, tw = xs                   # tw: [256, V, 3, 32] uint8
+        flat = tw.reshape(256 * V, 3, fe.NLIMBS)
+        sel = jnp.take(flat, digit * V + val_idx, axis=0).astype(jnp.int32)
+        aff = (sel[..., 0, :], sel[..., 1, :], sel[..., 2, :])
+        return pt_add_affine(acc, aff), None
 
     acc, _ = lax.scan(body, identity(s.shape[:-1]), (digits, tbl))
     return acc
@@ -241,9 +291,10 @@ def scalar_mul_comb(tbl, val_idx: jnp.ndarray, s: jnp.ndarray) -> tuple:
 
 @functools.lru_cache(maxsize=None)
 def _base_table() -> np.ndarray:
-    """np.int32[32, 256, 3, 32]: window w, digit j -> affine precomp of
-    j * 2^(8w) * B as (y+x, y-x, 2d*x*y) limb rows.  Built once host-side
-    from the golden bigint reference."""
+    """np.uint8[32, 256, 3, 32]: window w, digit j -> affine precomp of
+    j * 2^(8w) * B as (y+x, y-x, 2d*x*y) canonical byte rows (uint8 storage
+    quarters the per-window gather traffic).  Built once host-side from
+    the golden bigint reference."""
     pts = []
     P = ref.BASE
     for w in range(32):
@@ -258,7 +309,7 @@ def _base_table() -> np.ndarray:
         prefix.append(run)
         run = run * p[2] % ref.P
     run_inv = pow(run, ref.P - 2, ref.P)
-    tbl = np.zeros((32, 256, 3, fe.NLIMBS), dtype=np.int32)
+    tbl = np.zeros((32, 256, 3, fe.NLIMBS), dtype=np.uint8)
     for idx in range(len(pts) - 1, -1, -1):
         x, y, z, _ = pts[idx]
         zi = run_inv * prefix[idx] % ref.P
@@ -278,7 +329,7 @@ def scalar_mul_base(s: jnp.ndarray) -> tuple:
 
     def body(acc, xs):
         digit, tblw = xs
-        sel = jnp.take(tblw, digit, axis=0)    # [..., 3, 32]
+        sel = jnp.take(tblw, digit, axis=0).astype(jnp.int32)  # [..., 3, 32]
         aff = (sel[..., 0, :], sel[..., 1, :], sel[..., 2, :])
         return pt_add_affine(acc, aff), None
 

@@ -180,6 +180,63 @@ def pow22523(z: jnp.ndarray) -> jnp.ndarray:
     return mul(_nsqr(z_250_0, 2), z)
 
 
+def _batch_inv_nonzero(z: jnp.ndarray) -> jnp.ndarray:
+    """Blocked Montgomery inversion of NONZERO [N, 32] values.
+
+    Reshapes to [K, C] columns and runs two lax.scan product sweeps whose
+    body is a single `mul` — the traced graph stays tiny regardless of N
+    (a log-depth associative_scan here made XLA compile for minutes) —
+    then recurses on the C column totals until a small unrolled base.
+    Work is still ~5 muls per lane; sequential depth is ~2*sqrt pieces.
+    """
+    n = z.shape[0]
+    one = jnp.asarray(int_to_limbs(1))
+    if n <= 8:
+        # unrolled exclusive prefix/suffix products + one inversion ladder
+        pre, acc = [], jnp.broadcast_to(one, z.shape[-1:])
+        for i in range(n):
+            pre.append(acc)
+            acc = mul(acc, z[i]) if i < n - 1 else acc
+        suf, acc = [None] * n, jnp.broadcast_to(one, z.shape[-1:])
+        for i in range(n - 1, -1, -1):
+            suf[i] = acc
+            acc = mul(acc, z[i])
+        tinv = inv(acc)          # acc == product of all lanes
+        return jnp.stack([mul(mul(pre[i], suf[i]), tinv) for i in range(n)])
+    c = 1 << (max(n, 4).bit_length() // 2)       # columns ~ sqrt(n)
+    k = -(-n // c)
+    pad = k * c - n
+    zs = jnp.concatenate(
+        [z, jnp.broadcast_to(one, (pad, NLIMBS))]) if pad else z
+    cols = zs.reshape(k, c, NLIMBS)
+
+    def fwd(carry, row):
+        return mul(carry, row), carry            # ys = EXCLUSIVE prefix
+    ones_c = jnp.broadcast_to(one, (c, NLIMBS))
+    total, pre_ex = jax.lax.scan(fwd, ones_c, cols)
+    _, suf_ex_rev = jax.lax.scan(fwd, ones_c, cols[::-1])
+    suf_ex = suf_ex_rev[::-1]
+    tinv = _batch_inv_nonzero(total)             # recurse on [C] totals
+    zi = mul(mul(pre_ex, suf_ex), tinv[None, :, :])
+    return zi.reshape(k * c, NLIMBS)[:n]
+
+
+def batch_inv(z: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Montgomery batch inversion over the leading axis.
+
+    z int32[N, 32] -> (z^-1 int32[N, 32], nonzero bool[N]).  One ~265-mul
+    inversion ladder amortizes over the whole batch; per-lane cost is ~5
+    muls.  Lanes with z == 0 (no inverse) return 0 and are flagged False —
+    they are masked to 1 internally so they cannot zero a running product
+    and poison the rest of the batch.
+    """
+    nz = ~is_zero(z)
+    one = jnp.asarray(int_to_limbs(1))
+    zs = jnp.where(nz[..., None], z, one)
+    zi = _batch_inv_nonzero(zs)
+    return jnp.where(nz[..., None], zi, 0), nz
+
+
 def canonical(x: jnp.ndarray) -> jnp.ndarray:
     """Fully reduce to the canonical representative in [0, p), limbs [0,255]."""
     x = carry_exact(carry(x, passes=4))

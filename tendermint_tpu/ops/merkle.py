@@ -128,3 +128,4 @@ def roots(data: jnp.ndarray) -> jnp.ndarray:
 
 roots_jit = jax.jit(roots)
 root_from_leaf_hashes_jit = jax.jit(root_from_leaf_hashes)
+leaf_hashes_jit = jax.jit(leaf_hashes)

@@ -13,7 +13,6 @@ from __future__ import annotations
 import numpy as np
 import jax
 import jax.numpy as jnp
-from jax import lax
 
 _K = np.array([
     0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
@@ -47,37 +46,46 @@ def pad(nbytes: int) -> np.ndarray:
     return tail
 
 
-def _schedule(w16):
-    """Extend 16 message words [B,16] to 64 [B,64]."""
-    def body(i, w):
-        a = jnp.take(w, i - 15, axis=-1)
-        b = jnp.take(w, i - 2, axis=-1)
-        s0 = _rotr(a, 7) ^ _rotr(a, 18) ^ (a >> np.uint32(3))
-        s1 = _rotr(b, 17) ^ _rotr(b, 19) ^ (b >> np.uint32(10))
-        v = jnp.take(w, i - 16, axis=-1) + s0 + jnp.take(w, i - 7, axis=-1) + s1
-        return w.at[..., i].set(v)
-    w = jnp.concatenate(
-        [w16, jnp.zeros(w16.shape[:-1] + (48,), dtype=jnp.uint32)], axis=-1)
-    return lax.fori_loop(16, 64, body, w)
+_UNROLL = 16      # rounds per scan step: graph size vs carry traffic knob
 
 
 def _compress(state, w16):
-    w = _schedule(w16)
-    k = jnp.asarray(_K)
+    """One compression: lax.scan over round groups, _UNROLL rounds
+    unrolled per step, with the message schedule as a ROLLING 16-word
+    window in the carry.
 
-    def round_fn(i, st):
-        a, b, c, d, e, f, g, h = st
-        wi = jnp.take(w, i, axis=-1)
-        s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
-        ch = (e & f) ^ (~e & g)
-        t1 = h + s1 + ch + k[i] + wi
-        s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
-        maj = (a & b) ^ (a & c) ^ (b & c)
-        t2 = s0 + maj
-        return (t1 + t2, a, b, c, d + t1, e, f, g)
+    The window trick removes the [..., 64] schedule array and its
+    per-round dynamic indexing along the vector lane dim (the original
+    HBM-bound formulation); the partial unroll keeps the traced graph
+    small enough for XLA's CPU backend to compile in seconds (a fully
+    unrolled 64-round body took minutes of LLVM time) while the carry
+    (8 state + 16 window words) round-trips only once per 16 rounds.
+    At round i the window holds w[i..i+15]: consume window[0], generate
+    w[i+16] = w[i] + s0(w[i+1]) + w[i+9] + s1(w[i+14]), shift.
+    """
+    ks = jnp.asarray(_K.reshape(64 // _UNROLL, _UNROLL))
 
-    st = lax.fori_loop(0, 64, round_fn, tuple(state))
-    return tuple(s + n for s, n in zip(state, st))
+    def step(carry, k):
+        a, b, c, d, e, f, g, h = carry[:8]
+        w = list(carry[8:])
+        for j in range(_UNROLL):
+            wi = w[0]
+            ws0 = _rotr(w[1], 7) ^ _rotr(w[1], 18) ^ (w[1] >> np.uint32(3))
+            ws1 = (_rotr(w[14], 17) ^ _rotr(w[14], 19)
+                   ^ (w[14] >> np.uint32(10)))
+            w = w[1:] + [w[0] + ws0 + w[9] + ws1]
+            s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+            ch = (e & f) ^ (~e & g)
+            t1 = h + s1 + ch + k[j] + wi
+            s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+            maj = (a & b) ^ (a & c) ^ (b & c)
+            a, b, c, d, e, f, g, h = (t1 + s0 + maj, a, b, c,
+                                      d + t1, e, f, g)
+        return (a, b, c, d, e, f, g, h) + tuple(w), None
+
+    init = tuple(state) + tuple(w16[..., i] for i in range(16))
+    out, _ = jax.lax.scan(step, init, ks)
+    return tuple(s + n for s, n in zip(state, out[:8]))
 
 
 def sha256_blocks(blocks: jnp.ndarray) -> jnp.ndarray:

@@ -11,8 +11,8 @@ is static per call site (sign-bytes are fixed-layout, see
 from __future__ import annotations
 
 import numpy as np
+import jax
 import jax.numpy as jnp
-from jax import lax
 
 _K64 = [
     0x428a2f98d728ae22, 0x7137449123ef65cd, 0xb5c0fbcfec4d3b2f, 0xe9b5dba58189dbbc,
@@ -87,59 +87,70 @@ def _bytes_to_words(msg):
     return hi, lo
 
 
-def _schedule(wh, wl):
-    zeros = jnp.zeros(wh.shape[:-1] + (64,), dtype=jnp.uint32)
-    wh = jnp.concatenate([wh, zeros], axis=-1)
-    wl = jnp.concatenate([wl, zeros], axis=-1)
-
-    def body(i, wv):
-        wh, wl = wv
-        a_h, a_l = jnp.take(wh, i - 15, axis=-1), jnp.take(wl, i - 15, axis=-1)
-        b_h, b_l = jnp.take(wh, i - 2, axis=-1), jnp.take(wl, i - 2, axis=-1)
-        s0 = _xor3(_rotr64(a_h, a_l, 1), _rotr64(a_h, a_l, 8), _shr64(a_h, a_l, 7))
-        s1 = _xor3(_rotr64(b_h, b_l, 19), _rotr64(b_h, b_l, 61), _shr64(b_h, b_l, 6))
-        h, l = _add64(jnp.take(wh, i - 16, axis=-1), jnp.take(wl, i - 16, axis=-1),
-                      *s0)
-        h, l = _add64(h, l, jnp.take(wh, i - 7, axis=-1), jnp.take(wl, i - 7, axis=-1))
-        h, l = _add64(h, l, *s1)
-        return wh.at[..., i].set(h), wl.at[..., i].set(l)
-
-    return lax.fori_loop(16, 80, body, (wh, wl))
-
-
 def _xor3(a, b, c):
     return a[0] ^ b[0] ^ c[0], a[1] ^ b[1] ^ c[1]
 
 
+_UNROLL = 16      # rounds per scan step (see ops.sha256._compress)
+
+
 def _compress(state, wh16, wl16):
-    wh, wl = _schedule(wh16, wl16)
-    khi, klo = jnp.asarray(_KHI), jnp.asarray(_KLO)
+    """One compression: lax.scan over round groups with a rolling 16-pair
+    message window in the carry — same formulation as
+    `ops.sha256._compress` (which documents the why), with every 64-bit
+    word as a (hi, lo) uint32 pair."""
+    ks = jnp.asarray(
+        np.stack([_KHI.reshape(80 // _UNROLL, _UNROLL),
+                  _KLO.reshape(80 // _UNROLL, _UNROLL)], axis=1))
 
-    def round_fn(i, st):
+    def step(carry, k):
         (ah, al, bh, bl, ch_, cl, dh, dl,
-         eh, el, fh, fl, gh, gl, hh, hl) = st
-        s1 = _xor3(_rotr64(eh, el, 14), _rotr64(eh, el, 18), _rotr64(eh, el, 41))
-        chh = (eh & fh) ^ (~eh & gh)
-        chl = (el & fl) ^ (~el & gl)
-        th, tl = _add64(hh, hl, *s1)
-        th, tl = _add64(th, tl, chh, chl)
-        th, tl = _add64(th, tl, khi[i], klo[i])
-        th, tl = _add64(th, tl, jnp.take(wh, i, axis=-1), jnp.take(wl, i, axis=-1))
-        s0 = _xor3(_rotr64(ah, al, 28), _rotr64(ah, al, 34), _rotr64(ah, al, 39))
-        majh = (ah & bh) ^ (ah & ch_) ^ (bh & ch_)
-        majl = (al & bl) ^ (al & cl) ^ (bl & cl)
-        t2h, t2l = _add64(*s0, majh, majl)
-        ndh, ndl = _add64(dh, dl, th, tl)
-        nah, nal = _add64(th, tl, t2h, t2l)
-        return (nah, nal, ah, al, bh, bl, ch_, cl,
+         eh, el, fh, fl, gh, gl, hh, hl) = carry[:16]
+        wh = list(carry[16:32])
+        wl = list(carry[32:48])
+        for j in range(_UNROLL):
+            twh, twl = wh[0], wl[0]
+            a = (wh[1], wl[1])
+            b = (wh[14], wl[14])
+            s0 = _xor3(_rotr64(*a, 1), _rotr64(*a, 8), _shr64(*a, 7))
+            s1 = _xor3(_rotr64(*b, 19), _rotr64(*b, 61), _shr64(*b, 6))
+            nh, nl = _add64(wh[0], wl[0], *s0)
+            nh, nl = _add64(nh, nl, wh[9], wl[9])
+            nh, nl = _add64(nh, nl, *s1)
+            wh = wh[1:] + [nh]
+            wl = wl[1:] + [nl]
+            s1 = _xor3(_rotr64(eh, el, 14), _rotr64(eh, el, 18),
+                       _rotr64(eh, el, 41))
+            chh = (eh & fh) ^ (~eh & gh)
+            chl = (el & fl) ^ (~el & gl)
+            th, tl = _add64(hh, hl, *s1)
+            th, tl = _add64(th, tl, chh, chl)
+            th, tl = _add64(th, tl, k[0, j], k[1, j])
+            th, tl = _add64(th, tl, twh, twl)
+            s0 = _xor3(_rotr64(ah, al, 28), _rotr64(ah, al, 34),
+                       _rotr64(ah, al, 39))
+            majh = (ah & bh) ^ (ah & ch_) ^ (bh & ch_)
+            majl = (al & bl) ^ (al & cl) ^ (bl & cl)
+            t2h, t2l = _add64(*s0, majh, majl)
+            ndh, ndl = _add64(dh, dl, th, tl)
+            nah, nal = _add64(th, tl, t2h, t2l)
+            (ah, al, bh, bl, ch_, cl, dh, dl,
+             eh, el, fh, fl, gh, gl, hh, hl) = (
+                nah, nal, ah, al, bh, bl, ch_, cl,
                 ndh, ndl, eh, el, fh, fl, gh, gl)
+        st = (ah, al, bh, bl, ch_, cl, dh, dl,
+              eh, el, fh, fl, gh, gl, hh, hl)
+        return st + tuple(wh) + tuple(wl), None
 
-    st = lax.fori_loop(0, 80, round_fn, tuple(state))
-    out = []
+    init = (tuple(state) + tuple(wh16[..., i] for i in range(16))
+            + tuple(wl16[..., i] for i in range(16)))
+    out, _ = jax.lax.scan(step, init, ks)
+    res = []
     for i in range(8):
-        h, l = _add64(state[2 * i], state[2 * i + 1], st[2 * i], st[2 * i + 1])
-        out.extend([h, l])
-    return tuple(out)
+        h, l = _add64(state[2 * i], state[2 * i + 1],
+                      out[2 * i], out[2 * i + 1])
+        res.extend([h, l])
+    return tuple(res)
 
 
 def sha512(msg: jnp.ndarray) -> jnp.ndarray:

@@ -5,6 +5,14 @@ hashed buckets (256 "new" for unvetted, 64 "old" for proven), eviction is
 randomized within a full bucket, the book persists to JSON periodically
 and on close.  This implementation keeps the bucket structure and
 good/bad promotion semantics at a fraction of the size.
+
+Abuse resistance: a NEW address's bucket is derived from BOTH the /16
+group of the address and the /16 group of the peer that reported it
+(reference `p2p/addrbook.go` calcNewBucket) — a single gossip source can
+therefore occupy at most a handful of buckets no matter how many
+addresses it invents, and eviction pressure stays confined there.  OLD
+buckets key on the address group alone (proven peers vouch for
+themselves).
 """
 
 from __future__ import annotations
@@ -21,11 +29,12 @@ from tendermint_tpu.p2p.types import NetAddress
 NEW_BUCKETS = 256
 OLD_BUCKETS = 64
 BUCKET_SIZE = 64
+NEW_BUCKETS_PER_SRC = 8   # reference p2p/addrbook.go newBucketsPerGroup
 
 
 class _Entry:
     __slots__ = ("addr", "src", "attempts", "last_attempt", "last_success",
-                 "old")
+                 "old", "bucket")
 
     def __init__(self, addr: NetAddress, src: str):
         self.addr = addr
@@ -34,6 +43,7 @@ class _Entry:
         self.last_attempt = 0.0
         self.last_success = 0.0
         self.old = False
+        self.bucket = 0
 
     def to_json(self) -> dict:
         return {"addr": str(self.addr), "src": self.src,
@@ -61,13 +71,35 @@ class AddrBook:
 
     # -- bucket math (structure parity; buckets are implicit partitions) --
     @staticmethod
-    def _bucket_of(key: str, old: bool) -> int:
-        h = hashlib.sha256(key.encode()).digest()
-        return h[0] % (OLD_BUCKETS if old else NEW_BUCKETS)
+    def _group(host: str) -> str:
+        """/16-style group: first two dotted components (or the whole
+        host for names) — the poisoning-resistance granularity."""
+        return ".".join(host.split(".")[:2])
+
+    @classmethod
+    def _new_bucket_of(cls, key: str, src: str) -> int:
+        """Two-stage btcd hash: the address group picks one of
+        NEW_BUCKETS_PER_SRC slots, then (source group, slot) picks the
+        bucket — so a source GROUP reaches at most NEW_BUCKETS_PER_SRC
+        buckets total, no matter how many addresses it invents."""
+        host = key.rsplit(":", 1)[0]
+        src_host = src.rsplit(":", 1)[0] if src else ""
+        ag, sg = cls._group(host), cls._group(src_host)
+        slot = int.from_bytes(
+            hashlib.sha256((ag + "|" + sg).encode()).digest()[:2],
+            "big") % NEW_BUCKETS_PER_SRC
+        h = hashlib.sha256((sg + "|" + str(slot)).encode()).digest()
+        return int.from_bytes(h[:2], "big") % NEW_BUCKETS
+
+    @classmethod
+    def _old_bucket_of(cls, key: str) -> int:
+        host = key.rsplit(":", 1)[0]
+        h = hashlib.sha256(cls._group(host).encode()).digest()
+        return int.from_bytes(h[:2], "big") % OLD_BUCKETS
 
     def _bucket_members(self, bucket: int, old: bool) -> list[_Entry]:
-        return [e for k, e in self._entries.items()
-                if e.old == old and self._bucket_of(k, old) == bucket]
+        return [e for e in self._entries.values()
+                if e.old == old and e.bucket == bucket]
 
     # -- mutation -------------------------------------------------------
     def add_address(self, addr: NetAddress, src: str = "") -> bool:
@@ -78,8 +110,8 @@ class AddrBook:
             if key in self._entries:
                 return False
             e = _Entry(addr, src)
-            bucket = self._bucket_of(key, old=False)
-            members = self._bucket_members(bucket, old=False)
+            e.bucket = self._new_bucket_of(key, src)
+            members = self._bucket_members(e.bucket, old=False)
             if len(members) >= BUCKET_SIZE:
                 # randomized eviction of an unvetted address
                 evict = self._rng.choice(members)
@@ -104,12 +136,15 @@ class AddrBook:
             e.attempts = 0
             e.last_success = time.time()
             if not e.old:
-                bucket = self._bucket_of(addr.dial_string(), old=True)
+                bucket = self._old_bucket_of(addr.dial_string())
                 members = self._bucket_members(bucket, old=True)
                 if len(members) >= BUCKET_SIZE:
                     demote = self._rng.choice(members)
                     demote.old = False
+                    demote.bucket = self._new_bucket_of(
+                        demote.addr.dial_string(), demote.src)
                 e.old = True
+                e.bucket = bucket
 
     def mark_bad(self, addr: NetAddress) -> None:
         with self._lock:
@@ -162,6 +197,9 @@ class AddrBook:
                 data = json.load(f)
             for d in data.get("addrs", []):
                 e = _Entry.from_json(d)
-                self._entries[e.addr.dial_string()] = e
+                key = e.addr.dial_string()
+                e.bucket = (self._old_bucket_of(key) if e.old
+                            else self._new_bucket_of(key, e.src))
+                self._entries[key] = e
         except (OSError, ValueError, KeyError):
             pass                         # corrupt book: start fresh

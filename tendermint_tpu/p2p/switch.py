@@ -97,6 +97,11 @@ class Switch:
             p.stop()
         for r in self._reactors.values():
             r.stop()
+        # bounded join so a stopped net leaves no accept/dial threads
+        # gossiping into the next test's sockets
+        for t in self._threads:
+            if t.is_alive():
+                t.join(timeout=1.0)
 
     # -- peers ----------------------------------------------------------
     def peers(self) -> list[Peer]:

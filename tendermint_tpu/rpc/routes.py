@@ -154,7 +154,17 @@ class Routes:
         return {"genesis": json.loads(self.node.genesis_doc.to_json())}
 
     def dump_consensus_state(self, params: dict) -> dict:
-        return {"round_state": self.node.consensus.get_round_state_summary()}
+        """Full RoundState + per-peer round states (reference
+        `rpc/core/routes.go:21`, `rpc/core/consensus.go`)."""
+        peer_states = {}
+        sw = self.node.switch
+        if sw is not None:
+            for p in sw.peers():
+                ps = p.get("consensus")
+                if ps is not None:
+                    peer_states[p.id] = ps.summary()
+        return {"round_state": self.node.consensus.get_round_state_dump(),
+                "peer_round_states": peer_states}
 
     def net_info(self, params: dict) -> dict:
         sw = self.node.switch

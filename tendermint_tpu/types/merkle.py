@@ -109,7 +109,13 @@ def _compute_from_aunts(index: int, total: int, leaf: bytes,
 
 def proofs(items: list[bytes]) -> tuple[bytes, list[Proof]]:
     """Root plus one inclusion proof per item."""
-    hashes = [leaf_hash(i) for i in items]
+    return proofs_from_leaf_hashes([leaf_hash(i) for i in items])
+
+
+def proofs_from_leaf_hashes(hashes: list[bytes]) -> tuple[bytes, list[Proof]]:
+    """Root + proofs from precomputed leaf hashes — the seam that lets the
+    bulk leaf hashing run on the device (`ops.merkle.leaf_hashes`) while
+    the irregular tree/proof assembly stays host-side."""
     n = len(hashes)
     if n == 0:
         return root([]), []

@@ -6,16 +6,29 @@ tree with per-part inclusion proofs verified on receive
 (`types/part_set.go:95-122,188-214`).  Different peers serve different
 parts concurrently; the proof lets a receiver validate each part against
 the proposal's PartSetHeader before assembly.
+
+`from_data_batched` is the fast-sync path: the bulk hashing (full 64KB
+part chunks, the dominant cost of re-hashing big blocks) runs as ONE
+lockstep device batch, while the irregular work (short tail chunks, tree
+and proof assembly) stays on the host — the reference re-hashes each
+block serially on the CPU inside its sync loop
+(`blockchain/reactor.go:224`, `types/part_set.go:95-122`).
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
 
+import numpy as np
+
 from tendermint_tpu.types import merkle
 from tendermint_tpu.types.codec import Reader, lp_bytes, u32
 
 PART_SIZE = 64 * 1024  # reference types/block.go:19
+
+# Below this many full-size chunks in a batch the host's C hashing wins
+# (device dispatch + transfer overhead); above, lockstep lanes win.
+DEVICE_MIN_CHUNKS = 16
 
 
 @dataclass(frozen=True)
@@ -83,10 +96,12 @@ class PartSet:
     def from_data(cls, data: bytes, part_size: int = PART_SIZE) -> "PartSet":
         """Chunk serialized block bytes into proved parts
         (reference `types/part_set.go:95-122`)."""
-        chunks = [data[i:i + part_size] for i in range(0, len(data), part_size)]
-        if not chunks:
-            chunks = [b""]
-        rt, proofs = merkle.proofs(chunks)
+        return from_data_batched([data], part_size)[0]
+
+    @classmethod
+    def _assemble(cls, chunks: list[bytes],
+                  leaf_hashes: list[bytes]) -> "PartSet":
+        rt, proofs = merkle.proofs_from_leaf_hashes(leaf_hashes)
         ps = cls(PartSetHeader(len(chunks), rt))
         for i, (c, pr) in enumerate(zip(chunks, proofs)):
             ps._parts[i] = Part(i, c, pr)
@@ -129,3 +144,59 @@ class PartSet:
     def assemble(self) -> bytes:
         assert self.is_complete()
         return b"".join(p.bytes_ for p in self._parts)
+
+
+def _device_full_chunk_hashes(chunks: list[bytes],
+                              part_size: int) -> list[bytes] | None:
+    """Leaf-hash equal-size chunks in one lockstep device batch; None when
+    the device would lose to host hashlib (small batch, no tpu backend)."""
+    if len(chunks) < DEVICE_MIN_CHUNKS:
+        return None
+    from tendermint_tpu.crypto import backend as cb
+    if cb.get_backend().name != "tpu":
+        return None
+    try:
+        import jax.numpy as jnp
+        from tendermint_tpu.ops import merkle as dev_merkle
+    except ImportError:                  # pragma: no cover - env dependent
+        return None
+    n = len(chunks)
+    b = 1 << (n - 1).bit_length()        # pad count to a power of two so a
+    pad = b - n                          # few compiled shapes cover any load
+    arr = np.frombuffer(b"".join(chunks) + b"\x00" * (pad * part_size),
+                        np.uint8).reshape(b, part_size)
+    h = np.asarray(dev_merkle.leaf_hashes_jit(arr))
+    return [h[i].tobytes() for i in range(n)]
+
+
+def from_data_batched(datas: list[bytes],
+                      part_size: int = PART_SIZE) -> list["PartSet"]:
+    """Build PartSets for MANY serialized blocks at once.
+
+    All full-size (== part_size) chunks across the whole window are leaf-
+    hashed in one device batch; short tail chunks and the per-block
+    tree/proof assembly stay host-side.  Falls back to host hashing
+    entirely when the batch is too small to beat hashlib.
+    """
+    per_block: list[list[bytes]] = []
+    full: list[tuple[int, int]] = []     # (block, part) of full chunks
+    full_chunks: list[bytes] = []
+    for bi, data in enumerate(datas):
+        chunks = [data[i:i + part_size]
+                  for i in range(0, len(data), part_size)] or [b""]
+        per_block.append(chunks)
+        for pi, c in enumerate(chunks):
+            if len(c) == part_size:
+                full.append((bi, pi))
+                full_chunks.append(c)
+    hashes: list[list[bytes | None]] = [[None] * len(c) for c in per_block]
+    dev = _device_full_chunk_hashes(full_chunks, part_size)
+    if dev is not None:
+        for (bi, pi), h in zip(full, dev):
+            hashes[bi][pi] = h
+    out = []
+    for bi, chunks in enumerate(per_block):
+        lh = [h if h is not None else merkle.leaf_hash(c)
+              for c, h in zip(chunks, hashes[bi])]
+        out.append(PartSet._assemble(chunks, lh))
+    return out

@@ -141,6 +141,28 @@ class ValidatorSet:
         `types/validator_set.go:140-149`)."""
         return merkle.root([v.hash_bytes() for v in self.validators])
 
+    def set_key(self) -> bytes:
+        """Stable identity for crypto-backend table caching: a digest of
+        the MEMBER PUBKEYS only — comb tables depend on keys, not powers,
+        so a power-only EndBlock diff must not force a table rebuild."""
+        k = getattr(self, "_set_key", None)
+        if k is None:
+            import hashlib
+            k = self._set_key = hashlib.sha256(
+                self.pubs_matrix().tobytes()).digest()
+        return k
+
+    def pubs_matrix(self) -> np.ndarray:
+        """uint8[V, 32] of member pubkeys in validator order — the
+        fixed key set handed to Backend.verify_grouped."""
+        m = getattr(self, "_pubs_mat", None)
+        if m is None:
+            m = np.frombuffer(
+                b"".join(v.pub_key.bytes_ for v in self.validators),
+                np.uint8).reshape(len(self.validators), 32)
+            self._pubs_mat = m
+        return m
+
     def encode(self) -> bytes:
         out = u32(len(self.validators))
         for v in self.validators:
@@ -182,6 +204,8 @@ class ValidatorSet:
         self.validators = sorted(vals.values(), key=lambda v: v.address)
         self._total = sum(v.voting_power for v in self.validators)
         self._by_addr = {v.address: i for i, v in enumerate(self.validators)}
+        self._set_key = None     # membership/power changed: invalidate
+        self._pubs_mat = None    # the grouped-verify identity + key matrix
         if (self._proposer is not None and
                 self._proposer.address not in self._by_addr):
             self._proposer = None
@@ -194,11 +218,12 @@ class ValidatorSet:
         """Flatten a commit into verify arrays so callers can batch many
         commits into one device call.
 
-        Returns (pubs[N,32], msgs[N,128], sigs[N,64], powers[N]) covering
-        EVERY non-nil precommit at (height, commit.round) — all signatures
-        must verify, matching the reference's VerifyCommit which rejects a
-        commit carrying any invalid signature — with powers[i] = 0 for
-        precommits voting a different block (verified but not tallied).
+        Returns (pubs[N,32], msgs[N,128], sigs[N,64], powers[N], idxs[N])
+        covering EVERY non-nil precommit at (height, commit.round) — all
+        signatures must verify, matching the reference's VerifyCommit which
+        rejects a commit carrying any invalid signature — with powers[i] = 0
+        for precommits voting a different block (verified but not tallied)
+        and idxs[i] the signer's validator index (grouped-verify lane map).
         A structural error in any precommit raises ValueError.
         """
         if self.size() != commit.size():
@@ -207,7 +232,8 @@ class ValidatorSet:
         if commit.height() != height:
             raise ValueError(f"commit height {commit.height()} != {height}")
         round_ = commit.round()
-        pubs, msgs, sigs, powers = [], [], [], []
+        votes, sigs, powers, idxs = [], [], [], []
+        bid_key = block_id.key()
         for idx, v in enumerate(commit.precommits):
             if v is None:
                 continue
@@ -224,29 +250,50 @@ class ValidatorSet:
             val = self.validators[idx]
             if val.address != v.validator_address:
                 raise ValueError(f"commit vote {idx} address mismatch")
-            pubs.append(val.pub_key.bytes_)
-            msgs.append(v.sign_bytes(chain_id))
+            votes.append(v)
             sigs.append(v.signature)
             powers.append(val.voting_power
-                          if v.block_id.key() == block_id.key() else 0)
-        n = len(pubs)
+                          if v.block_id.key() == bid_key else 0)
+            idxs.append(idx)
+        n = len(votes)
+        idx_arr = np.asarray(idxs, dtype=np.int32)
+        # vectorized sign-bytes assembly (no per-vote Python packing):
+        # validate_basic pinned hash lengths to 0 or 32, so ljust-padding
+        # nil hashes with zeros matches the scalar writer exactly
+        msgs = canonical.batch_sign_bytes(
+            chain_id,
+            np.full(n, canonical.TYPE_PRECOMMIT, dtype=np.uint8),
+            np.full(n, height, dtype=np.uint64),
+            np.full(n, round_, dtype=np.uint32),
+            np.frombuffer(b"".join(v.block_id.hash.ljust(32, b"\x00")
+                                   for v in votes),
+                          np.uint8).reshape(n, 32) if n else
+            np.zeros((0, 32), np.uint8),
+            np.frombuffer(b"".join(v.block_id.parts.hash.ljust(32, b"\x00")
+                                   for v in votes),
+                          np.uint8).reshape(n, 32) if n else
+            np.zeros((0, 32), np.uint8),
+            np.asarray([v.block_id.parts.total for v in votes],
+                       dtype=np.uint32),
+        )
         return (
-            np.frombuffer(b"".join(pubs), np.uint8).reshape(n, 32),
-            np.frombuffer(b"".join(msgs), np.uint8).reshape(
-                n, canonical.SIGN_BYTES_LEN),
+            self.pubs_matrix()[idx_arr],
+            msgs,
             np.frombuffer(b"".join(sigs), np.uint8).reshape(n, 64),
             np.asarray(powers, dtype=np.int64),
+            idx_arr,
         )
 
     def verify_commit(self, chain_id: str, block_id, height: int,
                       commit) -> None:
         """Raise unless +2/3 of this set signed block_id at height
         (reference `types/validator_set.go:220-264`); signatures checked in
-        one crypto-backend batch."""
+        one crypto-backend batch against this set's cached comb tables."""
         from tendermint_tpu.crypto import backend as cb
-        pubs, msgs, sigs, powers = self.commit_verify_arrays(
+        pubs, msgs, sigs, powers, idxs = self.commit_verify_arrays(
             chain_id, block_id, height, commit)
-        ok = cb.verify_batch(pubs, msgs, sigs)
+        ok = cb.verify_grouped(self.set_key(), self.pubs_matrix(),
+                               idxs, msgs, sigs)
         if not ok.all():
             raise CommitSignatureError(height, int(np.argmin(ok)))
         tallied = int(powers.sum())
@@ -276,10 +323,11 @@ def verify_commits_batched(val_set: ValidatorSet, chain_id: str,
     arrays = [val_set.commit_verify_arrays(chain_id, bid, h, c)
               for bid, h, c in items]
     counts = [len(a[0]) for a in arrays]
-    pubs = np.concatenate([a[0] for a in arrays])
     msgs = np.concatenate([a[1] for a in arrays])
     sigs = np.concatenate([a[2] for a in arrays])
-    ok = cb.verify_batch(pubs, msgs, sigs)
+    idxs = np.concatenate([a[4] for a in arrays])
+    ok = cb.verify_grouped(val_set.set_key(), val_set.pubs_matrix(),
+                           idxs, msgs, sigs)
     off = 0
     total = val_set.total_voting_power()
     for (bid, h, _), a, n in zip(items, arrays, counts):

@@ -185,7 +185,7 @@ class VoteSet:
         from tendermint_tpu.crypto import backend as cb
         if not votes:
             return []
-        pubs, msgs, sigs, checkable = [], [], [], []
+        idxs, msgs, sigs, checkable = [], [], [], []
         for i, v in enumerate(votes):
             try:
                 v.validate_basic()
@@ -196,14 +196,17 @@ class VoteSet:
                     v.type == self.type and idx < self.size() and
                     self.val_set.validators[idx].address ==
                     v.validator_address):
-                pubs.append(self.val_set.validators[idx].pub_key.bytes_)
+                idxs.append(idx)
                 msgs.append(v.sign_bytes(self.chain_id))
                 sigs.append(v.signature)
                 checkable.append(i)
         ok = np.zeros(len(votes), dtype=bool)
         if checkable:
-            res = cb.verify_batch(
-                np.frombuffer(b"".join(pubs), np.uint8).reshape(-1, 32),
+            # grouped verify: signer keys come from the validator set, so
+            # device backends reuse the set's cached comb tables
+            res = cb.verify_grouped(
+                self.val_set.set_key(), self.val_set.pubs_matrix(),
+                np.asarray(idxs, dtype=np.int32),
                 np.frombuffer(b"".join(msgs), np.uint8).reshape(
                     -1, canonical.SIGN_BYTES_LEN),
                 np.frombuffer(b"".join(sigs), np.uint8).reshape(-1, 64))

@@ -91,6 +91,7 @@ class Registry:
         self.verify_batches = Counter()
         self.batch_occupancy = Summary()      # real/padded per batch
         self.device_step_seconds = Summary()  # wall time per device call
+        self.table_build_seconds = Summary()  # comb-table builds (per set)
         # sync plane
         self.blocks_synced = Counter()
         # p2p plane

@@ -1,0 +1,127 @@
+"""Differential tests for the grouped (fixed-key-set) verify path.
+
+The grouped kernel is the fast-sync hot plane: comb tables are built once
+per validator set (`ops.ed25519.build_neg_comb`) and every subsequent
+verify is 32 mixed adds per scalar plus a batched encode — it must agree
+with the golden bigint reference (`crypto.pure_ed25519.verify`) lane for
+lane on valid AND adversarial inputs, exactly like the generic kernel
+(reference semantics: one scalar verify per vote,
+`types/vote_set.go:175`, `types/validator_set.go:247-264`).
+"""
+
+import secrets
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from tendermint_tpu.crypto import pure_ed25519 as ref
+from tendermint_tpu.ops import ed25519 as dev
+
+MSG_LEN = 96
+V = 4
+
+
+@pytest.fixture(scope="module")
+def valset():
+    seeds = [secrets.token_bytes(32) for _ in range(V)]
+    pubs = [ref.pubkey_from_seed(s) for s in seeds]
+    vp = np.frombuffer(b"".join(pubs), np.uint8).reshape(V, 32)
+    tbl, ok = dev.build_neg_comb_jit(jnp.asarray(vp))
+    assert np.asarray(ok).all()
+    return seeds, pubs, vp, tbl, ok
+
+
+def _run(valset, idx, msgs, sigs):
+    _, _, vp, tbl, ok = valset
+    n = len(idx)
+    pad = 16 - n
+    assert pad >= 0
+    idx = np.asarray(list(idx) + [idx[0]] * pad, np.int32)
+    msgs = list(msgs) + [msgs[0]] * pad
+    sigs = list(sigs) + [sigs[0]] * pad
+    ma = np.frombuffer(b"".join(msgs), np.uint8).reshape(-1, MSG_LEN)
+    sa = np.frombuffer(b"".join(sigs), np.uint8).reshape(-1, 64)
+    got = dev.verify_grouped_jit(tbl, ok, jnp.asarray(idx),
+                                 jnp.asarray(vp[idx]), jnp.asarray(ma),
+                                 jnp.asarray(sa))
+    return np.asarray(got)[:n]
+
+
+def test_valid_batch(valset):
+    seeds, pubs, _, _, _ = valset
+    idx = [i % V for i in range(16)]
+    msgs = [secrets.token_bytes(MSG_LEN) for _ in range(16)]
+    sigs = [ref.sign(seeds[idx[i]], msgs[i]) for i in range(16)]
+    assert _run(valset, idx, msgs, sigs).all()
+
+
+def test_adversarial_lanes_match_golden(valset):
+    seeds, pubs, _, _, _ = valset
+    idx = [i % V for i in range(10)]
+    msgs = [secrets.token_bytes(MSG_LEN) for _ in range(10)]
+    sigs = [ref.sign(seeds[idx[i]], msgs[i]) for i in range(10)]
+    # s' = s + L (malleability): must be rejected by the s < L check
+    s_int = int.from_bytes(sigs[1][32:], "little")
+    sigs[1] = sigs[1][:32] + (s_int + ref.L).to_bytes(32, "little")
+    # non-canonical R encoding (y >= p)
+    sigs[2] = (2**255 - 19).to_bytes(32, "little") + sigs[2][32:]
+    # flipped message bit
+    m = bytearray(msgs[3]); m[0] ^= 1; msgs[3] = bytes(m)
+    # signature by the wrong validator of the right message
+    sigs[4] = ref.sign(seeds[(idx[4] + 1) % V], msgs[4])
+    # flipped sig bits in R and s halves
+    s = bytearray(sigs[5]); s[5] ^= 0x10; sigs[5] = bytes(s)
+    s = bytearray(sigs[6]); s[45] ^= 0x10; sigs[6] = bytes(s)
+    # R = identity encoding with s = 0 (always-false unless k*A == 0)
+    sigs[7] = (1).to_bytes(32, "little") + b"\x00" * 32
+    got = _run(valset, idx, msgs, sigs)
+    want = [ref.verify(pubs[idx[i]], msgs[i], sigs[i]) for i in range(10)]
+    assert got.tolist() == want
+    assert got.tolist() == [True, False, False, False, False, False,
+                            False, False, True, True]
+
+
+def test_invalid_pubkey_in_set():
+    """A non-decodable key in the set poisons only its own lanes."""
+    seeds = [secrets.token_bytes(32) for _ in range(V)]
+    pubs = [ref.pubkey_from_seed(s) for s in seeds]
+    pubs[2] = (2**255 - 1).to_bytes(32, "little")    # y >= p: undecodable
+    vp = np.frombuffer(b"".join(pubs), np.uint8).reshape(V, 32)
+    tbl, ok = dev.build_neg_comb_jit(jnp.asarray(vp))
+    assert np.asarray(ok).tolist() == [True, True, False, True]
+    idx = np.asarray([0, 1, 2, 3] * 4, np.int32)
+    msgs = [secrets.token_bytes(MSG_LEN) for _ in range(16)]
+    sigs = [ref.sign(seeds[idx[i]], msgs[i]) for i in range(16)]
+    ma = np.frombuffer(b"".join(msgs), np.uint8).reshape(-1, MSG_LEN)
+    sa = np.frombuffer(b"".join(sigs), np.uint8).reshape(-1, 64)
+    got = np.asarray(dev.verify_grouped_jit(
+        tbl, ok, jnp.asarray(idx), jnp.asarray(vp[idx]),
+        jnp.asarray(ma), jnp.asarray(sa)))
+    assert got.tolist() == [i % V != 2 for i in range(16)]
+
+
+def test_backend_grouped_matches_batch_and_caches():
+    from tendermint_tpu.crypto import backend as cb
+    be = cb.TpuBackend()
+    seeds = [secrets.token_bytes(32) for _ in range(V)]
+    pubs = [ref.pubkey_from_seed(s) for s in seeds]
+    vp = np.frombuffer(b"".join(pubs), np.uint8).reshape(V, 32)
+    idx = (np.arange(16) % V).astype(np.int32)
+    msgs = [secrets.token_bytes(MSG_LEN) for _ in range(16)]
+    sigs = [ref.sign(seeds[idx[i]], msgs[i]) for i in range(16)]
+    sigs[5] = sigs[6]                                 # one bad lane
+    ma = np.frombuffer(b"".join(msgs), np.uint8).reshape(-1, MSG_LEN)
+    sa = np.frombuffer(b"".join(sigs), np.uint8).reshape(-1, 64)
+    got = be.verify_grouped(b"set-a", vp, idx, ma, sa)
+    want = be.verify_batch(vp[idx], ma, sa)
+    assert got.tolist() == want.tolist()
+    assert not got[5] and got[4]
+    # second call hits the table cache (no rebuild)
+    assert b"set-a" in be._tables
+    n_tables = len(be._tables)
+    be.verify_grouped(b"set-a", vp, idx, ma, sa)
+    assert len(be._tables) == n_tables
+    # reusing a set_key for a different-sized set is refused
+    with pytest.raises(ValueError):
+        be.verify_grouped(b"set-a", vp[:2], idx % 2, ma, sa)

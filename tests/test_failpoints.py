@@ -1,0 +1,71 @@
+"""Crash-point enumeration: kill a real node at EVERY planted fail point,
+restart, and assert it recovers and keeps committing.
+
+Mirrors the reference's `test/persist/test_failure_indices.sh:1-45`
+(ebuchman/fail-test indices over `consensus/state.go:1285-1346` +
+`state/execution.go:218-237`).  The 8 planted points here
+(`consensus/state.py:580-595`, `state/execution.py:104-116`) all fire
+within one block commit, so TM_FAIL_INDEX 0..7 sweeps every
+store/WAL/app interleaving the crash-recovery design must survive:
+WAL-before-handle, store-before-state, ABCIResponses-before-commit.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from test_cli import ENV, _start_node, _wait_rpc_height
+
+N_FAIL_POINTS = 8        # grep fail_point( in consensus/state + execution
+
+
+def _init_home(tmp_path, chain_id):
+    home = str(tmp_path / "home")
+    out = subprocess.run(
+        [sys.executable, "-m", "tendermint_tpu.cli", "--home", home,
+         "init", "--chain-id", chain_id],
+        env=ENV, capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert out.returncode == 0, out.stderr
+    return home
+
+
+def _start_failing_node(home, port, fail_index):
+    env = {**ENV, "TM_FAIL_INDEX": str(fail_index)}
+    return subprocess.Popen(
+        [sys.executable, "-m", "tendermint_tpu.cli", "--home", home,
+         "node", "--rpc-laddr", f"tcp://127.0.0.1:{port}",
+         "--crypto-backend", "python"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("fail_index", range(N_FAIL_POINTS))
+def test_crash_at_every_fail_index_then_recover(tmp_path, fail_index):
+    port = 27700 + fail_index
+    home = _init_home(tmp_path, f"fail-chain-{fail_index}")
+    proc = _start_failing_node(home, port, fail_index)
+    try:
+        # the node must die AT the fail point (exit 66), not run through
+        deadline = time.time() + 40
+        while proc.poll() is None and time.time() < deadline:
+            time.sleep(0.1)
+        assert proc.poll() is not None, \
+            f"node never hit fail index {fail_index}"
+        out = proc.stdout.read().decode(errors="replace")
+        assert proc.returncode == 66, \
+            f"exit {proc.returncode} at index {fail_index}:\n{out[-2000:]}"
+        assert "FAIL_POINT hit" in out
+        # restart WITHOUT the fail index: handshake + WAL replay must
+        # reconcile whatever half-state the crash left behind
+        proc = _start_node(home, port)
+        h = _wait_rpc_height(port, 2, timeout=40)
+        assert h >= 2
+    finally:
+        proc.kill()
+        proc.wait(timeout=10)

@@ -116,3 +116,22 @@ def test_carry_adversarial_limbs():
     got = [fe.limbs_to_int(np.asarray(fe.canonical(fe.mul(arr, arr)))[i])
            for i in range(len(vals))]
     assert got == [v * v % P for v in vals]
+
+
+def test_batch_inv_sizes_and_zero_lanes():
+    """Blocked Montgomery inversion: exact inverses at sizes covering the
+    unrolled base, one scan level, and the recursive level; zero lanes are
+    flagged and must not poison their neighbours."""
+    for n in (1, 3, 8, 9, 40, 300):
+        xs, ax = _rand_batch(n)
+        if n >= 3:
+            xs[2] = 0
+            ax = ax.at[2].set(0)
+        zi, nz = jax.jit(fe.batch_inv)(ax)
+        zi, nz = np.asarray(zi), np.asarray(nz)
+        for i, v in enumerate(xs):
+            if v == 0:
+                assert not nz[i] and fe.limbs_to_int(zi[i]) == 0
+            else:
+                assert nz[i]
+                assert fe.limbs_to_int(zi[i]) % P == pow(v, P - 2, P), (n, i)

@@ -1,0 +1,46 @@
+"""gRPC broadcast API end-to-end (reference `rpc/grpc/api.go:14-32`)."""
+
+import time
+
+import pytest
+
+pytest.importorskip("grpc")
+
+from tendermint_tpu.config import test_config as fast_config
+from tendermint_tpu.node.node import Node
+from tendermint_tpu.rpc.grpc_server import GRPCClient
+from tendermint_tpu.types import (GenesisDoc, GenesisValidator, PrivKey,
+                                  PrivValidator)
+
+CHAIN = "grpc-chain"
+
+
+@pytest.fixture(scope="module")
+def node():
+    cfg = fast_config()
+    cfg.rpc.laddr = ""
+    cfg.rpc.grpc_laddr = "tcp://127.0.0.1:0"
+    cfg.p2p.laddr = ""
+    pv = PrivValidator(PrivKey(b"\x22" * 32))
+    gen = GenesisDoc(chain_id=CHAIN,
+                     validators=[GenesisValidator(pv.pub_key.bytes_, 10)],
+                     genesis_time_ns=1)
+    n = Node(cfg, priv_validator=pv, genesis_doc=gen)
+    n.start()
+    deadline = time.time() + 20
+    while time.time() < deadline and n.block_store.height < 1:
+        time.sleep(0.01)
+    assert n.block_store.height >= 1
+    yield n
+    n.stop()
+
+
+def test_ping_and_broadcast(node):
+    client = GRPCClient(node.grpc_server.laddr)
+    try:
+        assert client.ping()
+        res = client.broadcast_tx(b"grpc=99")
+        assert res["check_tx"]["code"] == 0
+        assert res["deliver_tx"]["code"] == 0
+    finally:
+        client.close()

@@ -1,0 +1,129 @@
+"""Light client: trusted-state advancement, two-set commits, multi-chain.
+
+The reference stubs `VerifyCommitAny` (`types/validator_set.go:268-290`);
+these tests pin down the implemented semantics: sequential following,
+authentication of supplied valsets against header.validators_hash, the
++2/3-of-both-sets rule on valset changes, and the multi-chain batch grid.
+"""
+
+import pytest
+
+from tendermint_tpu.crypto import backend as cb
+from tendermint_tpu.light import (ChainBatch, LightClient, TrustedState,
+                                  verify_chains_batched, verify_commit_any)
+from tendermint_tpu.light.client import SignedHeader
+from tendermint_tpu.types.block import BlockID
+from tendermint_tpu.types.validator import (CommitPowerError,
+                                            CommitSignatureError,
+                                            ValidatorSet, Validator)
+
+from chainutil import build_chain, make_commit, make_validators
+
+
+@pytest.fixture(autouse=True)
+def _backend():
+    cb.set_backend("python")
+
+
+def _chain(n=4, n_vals=4, chain_id="light-chain"):
+    privs, vs = make_validators(n_vals)
+    chain = build_chain(privs, vs, chain_id, n, txs_per_block=1)
+    return privs, vs, chain
+
+
+def test_sequential_follow():
+    privs, vs, chain = _chain(4)
+    lc = LightClient("light-chain", TrustedState(0, b"", vs))
+    for block, ps, seen in chain:
+        st = lc.update(SignedHeader(block.header, seen), vs, vs)
+        assert st.height == block.height
+        assert st.header_hash == block.hash()
+
+
+def test_rejects_wrong_valset_and_gaps():
+    privs, vs, chain = _chain(3)
+    other_privs, other_vs = make_validators(4, seed=9)
+    lc = LightClient("light-chain", TrustedState(0, b"", vs))
+    block, ps, seen = chain[0]
+    with pytest.raises(ValueError, match="validators_hash"):
+        lc.update(SignedHeader(block.header, seen), other_vs, other_vs)
+    # height gap
+    b2 = chain[2][0]
+    with pytest.raises(ValueError, match="non-sequential"):
+        lc.update(SignedHeader(b2.header, chain[2][2]), vs, vs)
+
+
+def test_rejects_tampered_commit():
+    privs, vs, chain = _chain(2)
+    lc = LightClient("light-chain", TrustedState(0, b"", vs))
+    block, ps, seen = chain[0]
+    # commit pointing at a different block id (votes untouched; the
+    # mismatch must be caught before any signature work)
+    from tendermint_tpu.types.block import Commit
+    bad = Commit(block_id=BlockID(b"\x55" * 32, ps.header),
+                 precommits=seen.precommits)
+    with pytest.raises(ValueError, match="not for this header"):
+        lc.update(SignedHeader(block.header, bad), vs, vs)
+
+
+def test_verify_commit_any_two_sets():
+    privs, vs, chain = _chain(4)
+    block, ps, seen = chain[0]
+    bid = BlockID(block.hash(), ps.header)
+    # new set: same members, one power bump (different hash, commit is
+    # index-aligned with the signing set)
+    verify_commit_any(vs, vs, "light-chain", bid, 1, seen)
+    # old set missing 2 of the 4 signers: only 2/4 of old power -> fail
+    old_small = ValidatorSet([Validator(p.pub_key, 10) for p in privs[:2]] +
+                             [Validator(make_validators(2, seed=7)[0][i]
+                                        .pub_key, 10) for i in range(2)])
+    with pytest.raises(CommitPowerError):
+        verify_commit_any(old_small, vs, "light-chain", bid, 1, seen)
+    # old set = subset of signers with enough overlap: 3 of 4 -> pass
+    old_over = ValidatorSet([Validator(p.pub_key, 10) for p in privs[:3]])
+    verify_commit_any(old_over, vs, "light-chain", bid, 1, seen)
+
+
+def test_update_through_valset_change():
+    chain_id = "light-chain"
+    privs, vs = make_validators(4)
+    chain = build_chain(privs, vs, chain_id, 1, txs_per_block=1)
+    lc = LightClient(chain_id, TrustedState(0, b"", vs))
+    b1, ps1, seen1 = chain[0]
+    lc.update(SignedHeader(b1.header, seen1), vs, vs)
+    # height 2 signed by a GROWN set (old 4 + 2 new members); +2/3 of the
+    # old set are present among the signers
+    extra_privs, _ = make_validators(2, seed=5)
+    new_vals = ([Validator(p.pub_key, 10) for p in privs] +
+                [Validator(p.pub_key, 10) for p in extra_privs])
+    new_vs = ValidatorSet(new_vals)
+    all_privs = sorted(privs + extra_privs, key=lambda p: p.address)
+    from tendermint_tpu.types.block import Block
+    b2 = Block.make(chain_id=chain_id, height=2, time_ns=2_000_000_000,
+                    txs=[b"t"], last_commit=seen1,
+                    last_block_id=BlockID(b1.hash(), ps1.header),
+                    validators_hash=new_vs.hash(), app_hash=b"")
+    ps2 = b2.make_part_set()
+    seen2 = make_commit(all_privs, new_vs, chain_id, 2,
+                        BlockID(b2.hash(), ps2.header))
+    st = lc.update(SignedHeader(b2.header, seen2), new_vs, new_vs)
+    assert st.height == 2
+    assert lc.trusted.next_validators is new_vs
+
+
+def test_verify_chains_batched_multi_chain():
+    chains = []
+    for c in range(3):
+        cid = f"chain-{c}"
+        privs, vs = make_validators(4, seed=c)
+        chain = build_chain(privs, vs, cid, 3, txs_per_block=1)
+        items = [(BlockID(b.hash(), ps.header), b.height, seen)
+                 for b, ps, seen in chain]
+        chains.append(ChainBatch(cid, vs, items))
+    verify_chains_batched(chains)
+    # corrupt one chain's one commit -> that chain fails
+    bad = chains[1]
+    bid, h, seen = bad.items[1]
+    seen.precommits[0] = seen.precommits[1]   # wrong lane: addr mismatch
+    with pytest.raises(ValueError):
+        verify_chains_batched(chains)
