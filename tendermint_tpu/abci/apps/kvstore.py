@@ -18,18 +18,40 @@ from tendermint_tpu.abci.types import (OK, ResponseInfo,
                                        ResponseQuery, Result)
 
 
+N_BUCKETS = 256
+
+
 class KVStoreApp(Application):
     def __init__(self):
         self.state: dict[bytes, bytes] = {}
         self.height = 0
+        # incremental state commitment: keys shard into 256 buckets by
+        # key digest; a write re-hashes only its bucket (O(state/256))
+        # and the app hash roots the bucket digests.  A full sorted
+        # re-hash per commit is O(state) and turns long replays
+        # quadratic (the reference dummy app's merkle tree is
+        # incremental for the same reason); plain XOR/sum accumulators
+        # are LINEAR and therefore forgeable — nested sha256 is not.
+        self._buckets: list[dict[bytes, bytes]] = [
+            {} for _ in range(N_BUCKETS)]
+        self._bucket_digest = [bytes(32)] * N_BUCKETS
+
+    def _set(self, k: bytes, v: bytes) -> None:
+        b = hashlib.sha256(k).digest()[0]
+        self.state[k] = v
+        bucket = self._buckets[b]
+        bucket[k] = v
+        h = hashlib.sha256()
+        for bk in sorted(bucket):
+            bv = bucket[bk]
+            h.update(len(bk).to_bytes(4, "big") + bk)
+            h.update(len(bv).to_bytes(4, "big") + bv)
+        self._bucket_digest[b] = h.digest()
 
     def _app_hash(self) -> bytes:
-        h = hashlib.sha256()
-        for k in sorted(self.state):
-            h.update(len(k).to_bytes(4, "big") + k)
-            h.update(len(self.state[k]).to_bytes(4, "big") + self.state[k])
-        h.update(self.height.to_bytes(8, "big"))
-        return h.digest()[:20]
+        return hashlib.sha256(
+            b"".join(self._bucket_digest) +
+            self.height.to_bytes(8, "big")).digest()[:20]
 
     def info(self) -> ResponseInfo:
         return ResponseInfo(data=f"{{\"size\":{len(self.state)}}}",
@@ -45,7 +67,7 @@ class KVStoreApp(Application):
             k, v = tx.split(b"=", 1)
         else:
             k = v = tx
-        self.state[k] = v
+        self._set(k, v)
         return Result(OK)
 
     def end_block(self, height: int):
@@ -81,8 +103,8 @@ class PersistentKVStoreApp(KVStoreApp):
             with open(self.db_path) as f:
                 d = json.load(f)
             self.height = d["height"]
-            self.state = {bytes.fromhex(k): bytes.fromhex(v)
-                          for k, v in d["state"].items()}
+            for k, v in d["state"].items():
+                self._set(bytes.fromhex(k), bytes.fromhex(v))
 
     def commit(self) -> Result:
         res = super().commit()

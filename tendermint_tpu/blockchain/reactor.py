@@ -40,6 +40,40 @@ STATUS_INTERVAL = 2.0            # reference statusUpdateTicker (10s)
 DEFAULT_BATCH = 64               # blocks verified per device call
 
 
+class _Lookahead:
+    """Speculative verification of the NEXT sync window in a background
+    thread: part-set re-hash + grouped device verify against a validator
+    set SNAPSHOT, while the main loop applies the current window.  The
+    consumer (`_sync_step`) discards the result unless the live set hash
+    and next height still match; verification errors are recorded, not
+    acted on — the synchronous path re-verifies and owns the blame logic."""
+
+    def __init__(self, vals, chain_id: str, blocks):
+        self.vals_hash = vals.hash()
+        self.first_height = blocks[0].height
+        self.window = None
+        self.parts_list = None
+        self.items = None
+        self.error: BaseException | None = None
+        self._vals = vals
+        self._chain_id = chain_id
+        self._blocks = blocks
+        self.thread = threading.Thread(target=self._run, daemon=True,
+                                       name="fastsync-lookahead")
+        self.thread.start()
+
+    def _run(self) -> None:
+        try:
+            window, parts_list, items = BlockchainReactor._prepare_window(
+                self._blocks, self.vals_hash)
+            if window:
+                verify_commits_batched(self._vals, self._chain_id, items)
+            self.window, self.parts_list, self.items = (window, parts_list,
+                                                        items)
+        except BaseException as e:
+            self.error = e
+
+
 class BlockchainReactor(Reactor):
     def __init__(self, state, proxy_consensus, block_store,
                  fast_sync: bool = True, batch_size: int = DEFAULT_BATCH):
@@ -55,6 +89,8 @@ class BlockchainReactor(Reactor):
         self._stopped = threading.Event()
         self._thread: threading.Thread | None = None
         self._switched = False
+        self._lookahead: _Lookahead | None = None
+        self.lookahead_hits = 0     # speculative windows actually consumed
 
     def get_channels(self):
         return [ChannelDescriptor(id=BLOCKCHAIN_CHANNEL, priority=5,
@@ -69,6 +105,9 @@ class BlockchainReactor(Reactor):
 
     def stop(self) -> None:
         self._stopped.set()
+        la = self._lookahead
+        if la is not None:
+            la.thread.join(timeout=5)
 
     # -- peer lifecycle -------------------------------------------------
     def add_peer(self, peer: Peer) -> None:
@@ -154,59 +193,94 @@ class BlockchainReactor(Reactor):
                 peer.try_send(BLOCKCHAIN_CHANNEL,
                               BM.encode_msg(BM.BlockRequest(height)))
 
-    def _sync_step(self) -> bool:
-        """Drain one verified window: batch-verify K contiguous blocks'
-        commits in one device call, then save + apply each."""
-        blocks = self.pool.peek_contiguous(self.batch_size + 1)
-        if len(blocks) < 2:
-            return False
+    @staticmethod
+    def _prepare_window(blocks, vals_hash: bytes):
+        """Cut the window at the first valset change, re-hash part sets in
+        one device batch, and assemble verify items.
+
+        Each header commits to the validator set of ITS height.  EndBlock
+        diffs can change the set mid-window, so only the prefix whose
+        headers match vals_hash is prepared; the rest re-verifies next
+        tick against the updated state (reference verifies per block:
+        `blockchain/reactor.go:230-231`).  Returns (window, parts_list,
+        items); an empty window means the very next block mismatches.
+        """
         window = blocks[:-1]              # each needs its successor's
-        chain_id = self.state.chain_id    # LastCommit as its +2/3 proof
-        # Each header commits to the validator set of ITS height.  EndBlock
-        # diffs can change the set mid-window, so verify only the prefix
-        # whose headers match the current set; the rest re-verifies next
-        # tick against the updated state (reference verifies per block:
-        # `blockchain/reactor.go:230-231`).
-        vals_hash = self.state.validators.hash()
-        cut = len(window)
+        cut = len(window)                 # LastCommit as its +2/3 proof
         for i, b in enumerate(window):
             if b.header.validators_hash != vals_hash:
                 cut = i
                 break
-        if cut == 0:
-            # the very next block disagrees with our state's validator set:
-            # the block is bad (or stale) — re-fetch it from someone else
-            log.warn("next block's validators_hash mismatches state",
-                     height=window[0].height)
-            self.pool.redo(window[0].height)
-            return False
         window = window[:cut]
-        # re-hash the whole window's part sets in one device batch (full
-        # 64KB chunks lockstep on device, tails + trees on host) — proving
-        # data integrity like the reference's per-block re-hash
+        # full 64KB chunks lockstep on device, tails + trees on host —
+        # proving data integrity like the reference's per-block re-hash
         # (`blockchain/reactor.go:224`) at batch rates
         parts_list = from_data_batched([b.encode() for b in window])
         items = []
         for i, b in enumerate(window):
             bid = BlockID(b.hash(), parts_list[i].header)
             items.append((bid, b.height, blocks[i + 1].last_commit))
+        return window, parts_list, items
+
+    def _sync_step(self) -> bool:
+        """Drain one verified window: batch-verify K contiguous blocks'
+        commits in one device call, then save + apply each — with the
+        NEXT window verified speculatively in a background thread while
+        this one applies (device verify and host ABCI/store work overlap;
+        the speculation is discarded if the validator set moved)."""
+        peek = self.pool.peek_contiguous(2 * (self.batch_size + 1))
+        if len(peek) < 2:
+            return False
+        blocks = peek[:self.batch_size + 1]
+        chain_id = self.state.chain_id
+        vals_hash = self.state.validators.hash()
+        verified = None
+        la, self._lookahead = self._lookahead, None
+        if la is not None:
+            la.thread.join()
+            if (la.error is None and la.window and
+                    la.vals_hash == vals_hash and
+                    la.first_height == blocks[0].height):
+                verified = (la.window, la.parts_list, la.items)
+                self.lookahead_hits += 1
+            # stale or failed speculation: fall through and re-verify
+            # synchronously so the error/redo paths below stay in charge
         t0 = time.perf_counter()
-        try:
-            verify_commits_batched(self.state.validators, chain_id, items)
-        except CommitSignatureError as e:
-            # the commit for height h rides in block h+1's LastCommit:
-            # a forged signature implicates the successor's deliverer
-            log.warn("bad commit signature; punishing deliverer",
-                     height=e.height)
-            self.pool.redo(e.height + 1)
-            return False
-        except CommitPowerError as e:
-            # votes point at a different block id: block content tampered
-            log.warn("commit power short; punishing deliverer",
-                     height=e.height)
-            self.pool.redo(e.height)
-            return False
+        if verified is None:
+            window, parts_list, items = self._prepare_window(blocks,
+                                                             vals_hash)
+            if not window:
+                # the very next block disagrees with our state's validator
+                # set: the block is bad (or stale) — re-fetch it elsewhere
+                log.warn("next block's validators_hash mismatches state",
+                         height=blocks[0].height)
+                self.pool.redo(blocks[0].height)
+                return False
+            try:
+                verify_commits_batched(self.state.validators, chain_id,
+                                       items)
+            except CommitSignatureError as e:
+                # the commit for height h rides in block h+1's LastCommit:
+                # a forged signature implicates the successor's deliverer
+                log.warn("bad commit signature; punishing deliverer",
+                         height=e.height)
+                self.pool.redo(e.height + 1)
+                return False
+            except CommitPowerError as e:
+                # votes point at a different block: content tampered
+                log.warn("commit power short; punishing deliverer",
+                         height=e.height)
+                self.pool.redo(e.height)
+                return False
+            verified = (window, parts_list, items)
+        window, parts_list, items = verified
         dt = time.perf_counter() - t0
+        # speculative verify-ahead: the next contiguous window, against a
+        # SNAPSHOT of the current set (apply below mutates the live one)
+        nxt = peek[len(window):len(window) + self.batch_size + 1]
+        if len(nxt) >= 2 and not self._stopped.is_set():
+            self._lookahead = _Lookahead(
+                self.state.validators.copy(), chain_id, nxt)
         applied = 0
         for b, parts, (bid, h, commit) in zip(window, parts_list, items):
             # store-before-state is the crash-recovery discipline (the

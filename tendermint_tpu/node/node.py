@@ -94,6 +94,15 @@ class Node:
             priv_validator=self.priv_validator, evsw=self.evsw,
             wal_path=wal_path, tx_indexer=self.tx_indexer)
 
+        # --- evidence pool (equivocation proofs, SURVEY §2.2) ---
+        from tendermint_tpu.state.evidence import EvidencePool
+        self.evidence_pool = EvidencePool(mk("evidence"),
+                                          self.genesis_doc.chain_id)
+        self.evsw.subscribe(
+            "node-evidence", "EvidenceDoubleSign",
+            lambda ev: self.evidence_pool.add(
+                ev, self.consensus.state.validators))
+
         # --- p2p switch (built when a listen addr is configured) ---
         self.switch = None
         self._maybe_build_p2p()

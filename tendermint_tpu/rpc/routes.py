@@ -79,6 +79,10 @@ class Routes:
             "num_unconfirmed_txs": self.num_unconfirmed_txs,
             "tx": self.tx,
             "net_info": self.net_info,
+            "evidence": self.evidence,
+            "debug_stacks": self.debug_stacks,
+            "debug_trace_start": self.debug_trace_start,
+            "debug_trace_stop": self.debug_trace_stop,
         }
 
     # -- info routes ----------------------------------------------------
@@ -165,6 +169,41 @@ class Routes:
                     peer_states[p.id] = ps.summary()
         return {"round_state": self.node.consensus.get_round_state_dump(),
                 "peer_round_states": peer_states}
+
+    def evidence(self, params: dict) -> dict:
+        """Pending equivocation proofs from the evidence pool."""
+        def vote_d(v):
+            return {"validator": _hexb(v.validator_address),
+                    "height": v.height, "round": v.round, "type": v.type,
+                    "block_hash": _hexb(v.block_id.hash)}
+        pool = getattr(self.node, "evidence_pool", None)
+        if pool is None:
+            return {"evidence": [], "count": 0}
+        evs = pool.pending()
+        return {"count": len(evs),
+                "evidence": [{"vote_a": vote_d(e.vote_a),
+                              "vote_b": vote_d(e.vote_b)} for e in evs]}
+
+    # -- debug/profiling routes (reference pprof endpoints analog) --------
+    def debug_stacks(self, params: dict) -> dict:
+        from tendermint_tpu.utils import trace
+        return {"threads": trace.thread_stacks()}
+
+    def debug_trace_start(self, params: dict) -> dict:
+        import os
+        import re
+        from tendermint_tpu.utils import trace
+        # the name is an RPC param: allow only a flat subdirectory under
+        # the fixed trace base (no path escape / arbitrary-dir writes)
+        name = str(params.get("name") or "trace")
+        if not re.fullmatch(r"[A-Za-z0-9._-]{1,64}", name):
+            raise ValueError("trace name must match [A-Za-z0-9._-]{1,64}")
+        d = os.path.join("/tmp/tendermint_tpu_trace", name)
+        return {"started": trace.start_device_trace(d), "dir": d}
+
+    def debug_trace_stop(self, params: dict) -> dict:
+        from tendermint_tpu.utils import trace
+        return {"dir": trace.stop_device_trace()}
 
     def net_info(self, params: dict) -> dict:
         sw = self.node.switch

@@ -180,6 +180,10 @@ class Block:
     last_commit: Commit
 
     _hash: bytes | None = field(default=None, repr=False, compare=False)
+    # blocks are value objects: the serialization is cached (and seeded
+    # with the wire bytes on decode) so fast-sync's part-set re-hash does
+    # not re-encode a 100-vote commit per block
+    _encoded: bytes | None = field(default=None, repr=False, compare=False)
 
     @classmethod
     def make(cls, chain_id: str, height: int, time_ns: int, txs: list[bytes],
@@ -225,12 +229,14 @@ class Block:
             self.last_commit.validate_basic()
 
     def encode(self) -> bytes:
-        out = self.header.encode()
-        out += u32(len(self.txs))
-        for tx in self.txs:
-            out += lp_bytes(tx)
-        out += self.last_commit.encode()
-        return out
+        if self._encoded is None:
+            out = self.header.encode()
+            out += u32(len(self.txs))
+            for tx in self.txs:
+                out += lp_bytes(tx)
+            out += self.last_commit.encode()
+            self._encoded = out
+        return self._encoded
 
     @classmethod
     def decode_bytes(cls, data: bytes) -> "Block":
@@ -239,7 +245,9 @@ class Block:
         txs = [r.lp_bytes() for _ in range(r.u32())]
         last_commit = Commit.decode(r)
         r.expect_done()
-        return cls(header=header, txs=txs, last_commit=last_commit)
+        blk = cls(header=header, txs=txs, last_commit=last_commit)
+        blk._encoded = data   # deterministic codec: decode/encode roundtrip
+        return blk
 
     def make_part_set(self, part_size: int | None = None) -> PartSet:
         """Serialize and chunk (reference `types/block.go:115-117`)."""

@@ -191,6 +191,14 @@ def from_data_batched(datas: list[bytes],
                 full_chunks.append(c)
     hashes: list[list[bytes | None]] = [[None] * len(c) for c in per_block]
     dev = _device_full_chunk_hashes(full_chunks, part_size)
+    if dev is None and len(full_chunks) >= DEVICE_MIN_CHUNKS:
+        # native threaded C++ engine for the bulk when the device path
+        # declined (no tpu backend / toolchain-built lib available)
+        from tendermint_tpu.utils import nativelib
+        arr = nativelib.leaf_hashes(np.frombuffer(
+            b"".join(full_chunks), np.uint8).reshape(-1, part_size))
+        if arr is not None:
+            dev = [arr[i].tobytes() for i in range(len(full_chunks))]
     if dev is not None:
         for (bi, pi), h in zip(full, dev):
             hashes[bi][pi] = h

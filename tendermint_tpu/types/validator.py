@@ -164,12 +164,24 @@ class ValidatorSet:
         return m
 
     def encode(self) -> bytes:
-        out = u32(len(self.validators))
-        for v in self.validators:
-            out += v.encode()
+        """Vectorized assembly: the state layer persists BOTH valsets on
+        every committed block, so a per-validator Python loop (~200 calls
+        at V=100) is real per-block cost in fast-sync replay.  Entries are
+        fixed 52-byte rows (u32 len=32 || pub32 || i64 power || i64 accum)
+        built in one numpy buffer."""
+        n = len(self.validators)
+        rows = np.zeros((n, 52), dtype=np.uint8)
+        rows[:, 0:4] = np.frombuffer(u32(32) * n,
+                                     np.uint8).reshape(n, 4)
+        rows[:, 4:36] = self.pubs_matrix()
+        rows[:, 36:44] = np.asarray(
+            [v.voting_power for v in self.validators],
+            dtype=">i8").view(np.uint8).reshape(n, 8)
+        rows[:, 44:52] = np.asarray(
+            [v.accum for v in self.validators],
+            dtype=">i8").view(np.uint8).reshape(n, 8)
         prop = self.index_of(self._proposer.address) if self._proposer else -1
-        out += i64(prop)
-        return out
+        return u32(n) + rows.tobytes() + i64(prop)
 
     @classmethod
     def decode(cls, r: Reader) -> "ValidatorSet":

@@ -1,0 +1,107 @@
+"""ctypes binding for the native host runtime (native/tmhash.cpp).
+
+Builds the shared library on demand with g++ (the environment's native
+toolchain; no pybind11) into the repo's native/ dir, caching the .so next
+to its source.  Every entry point degrades to None when the toolchain or
+library is unavailable — callers fall back to hashlib paths.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+from tendermint_tpu.utils.log import get_logger
+
+log = get_logger("nativelib")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "native")
+_SRC = os.path.join(_NATIVE_DIR, "tmhash.cpp")
+_SO = os.path.join(_NATIVE_DIR, "libtmhash.so")
+
+
+def _build() -> bool:
+    try:
+        r = subprocess.run(
+            ["g++", "-O2", "-std=c++17", "-fPIC", "-pthread", "-shared",
+             "-o", _SO, _SRC],
+            capture_output=True, text=True, timeout=120)
+        if r.returncode != 0:
+            log.warn("native build failed", err=r.stderr[-500:])
+            return False
+        return True
+    except (OSError, subprocess.TimeoutExpired) as e:
+        log.warn("native build unavailable", err=str(e))
+        return False
+
+
+def get() -> ctypes.CDLL | None:
+    """The loaded library, building it if needed; None when unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SRC):
+            return None
+        if (not os.path.exists(_SO) or
+                os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError as e:
+            log.warn("native lib load failed", err=str(e))
+            return None
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.tm_leaf_hashes.argtypes = [u8p, ctypes.c_uint64,
+                                       ctypes.c_uint64, u8p,
+                                       ctypes.c_uint32]
+        lib.tm_merkle_roots.argtypes = [u8p, ctypes.c_uint64,
+                                        ctypes.c_uint64, ctypes.c_uint64,
+                                        u8p, ctypes.c_uint32]
+        _lib = lib
+        return _lib
+
+
+def _threads() -> int:
+    return min(16, os.cpu_count() or 1)
+
+
+def _u8p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def leaf_hashes(msgs: np.ndarray) -> np.ndarray | None:
+    """uint8[N, L] -> 0x00-prefixed sha256 digests uint8[N, 32]."""
+    lib = get()
+    if lib is None:
+        return None
+    msgs = np.ascontiguousarray(msgs, dtype=np.uint8)
+    n, ln = msgs.shape
+    out = np.empty((n, 32), dtype=np.uint8)
+    lib.tm_leaf_hashes(_u8p(msgs), n, ln, _u8p(out), _threads())
+    return out
+
+
+def merkle_roots(leaves: np.ndarray) -> np.ndarray | None:
+    """uint8[T, N, L] equal-shape trees -> roots uint8[T, 32]
+    (reference-shaped (n+1)//2 split, domain-separated)."""
+    lib = get()
+    if lib is None:
+        return None
+    leaves = np.ascontiguousarray(leaves, dtype=np.uint8)
+    t, n, ln = leaves.shape
+    out = np.empty((t, 32), dtype=np.uint8)
+    lib.tm_merkle_roots(_u8p(leaves), t, n, ln, _u8p(out), _threads())
+    return out

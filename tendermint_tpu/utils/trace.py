@@ -1,0 +1,63 @@
+"""Tracing/profiling hooks: thread-stack dumps + JAX device traces.
+
+The reference exposes pprof-style debug endpoints (`rpc/core` net/http
+pprof wiring); the analogs here are:
+
+  * `thread_stacks()` — every live thread's Python stack (the goroutine
+    dump analog; invaluable for gossip/consensus deadlock triage),
+  * `start_device_trace` / `stop_device_trace` — the JAX profiler
+    (XPlane traces viewable in TensorBoard/Perfetto), capturing device
+    kernel timelines for the verify/merkle hot plane.
+
+Both are served by the `debug_*` RPC routes (`rpc/routes.py`).
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import traceback
+
+from tendermint_tpu.utils.log import get_logger
+
+log = get_logger("trace")
+
+_trace_lock = threading.Lock()
+_trace_dir: str | None = None
+
+
+def thread_stacks() -> dict[str, list[str]]:
+    """Name -> formatted stack for every live Python thread."""
+    frames = sys._current_frames()
+    out = {}
+    for t in threading.enumerate():
+        f = frames.get(t.ident)
+        name = f"{t.name}{'(daemon)' if t.daemon else ''}"
+        out[name] = traceback.format_stack(f) if f is not None else []
+    return out
+
+
+def start_device_trace(trace_dir: str) -> bool:
+    """Begin a JAX profiler capture; False if one is already running."""
+    global _trace_dir
+    with _trace_lock:
+        if _trace_dir is not None:
+            return False
+        import jax
+        jax.profiler.start_trace(trace_dir)
+        _trace_dir = trace_dir
+        log.info("device trace started", dir=trace_dir)
+        return True
+
+
+def stop_device_trace() -> str | None:
+    """Stop the capture; returns the trace dir (None if none running)."""
+    global _trace_dir
+    with _trace_lock:
+        if _trace_dir is None:
+            return None
+        import jax
+        jax.profiler.stop_trace()
+        d, _trace_dir = _trace_dir, None
+        log.info("device trace stopped", dir=d)
+        return d

@@ -47,11 +47,27 @@ def sign_vote(priv: PrivValidator, vs: ValidatorSet, chain_id: str,
 
 def make_commit(privs, vs: ValidatorSet, chain_id: str, height: int,
                 block_id, round_: int = 0) -> Commit:
+    # sign across validators in parallel (independent keys, native signing
+    # releases the GIL) — big bench chains need hundreds of thousands of
+    # votes; accounting stays sequential
+    votes = list(_sign_pool().map(
+        lambda p: sign_vote(p, vs, chain_id, height, round_,
+                            TYPE_PRECOMMIT, block_id), privs))
     vset = VoteSet(chain_id, height, round_, TYPE_PRECOMMIT, vs)
-    for p in privs:
-        vset.add_vote(sign_vote(p, vs, chain_id, height, round_,
-                                TYPE_PRECOMMIT, block_id))
+    for v in votes:
+        vset.add_vote(v)
     return vset.make_commit()
+
+
+_pool = None
+
+
+def _sign_pool():
+    global _pool
+    if _pool is None:
+        from concurrent.futures import ThreadPoolExecutor
+        _pool = ThreadPoolExecutor(8)
+    return _pool
 
 
 def kvstore_app_hashes(n: int, txs_per_block: int = 2) -> list[bytes]:

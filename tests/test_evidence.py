@@ -1,0 +1,114 @@
+"""Evidence pool: verification, dedup, persistence (SURVEY §2.2 depth
+past the reference era's log-and-drop, `types/vote_set.go:195-211`)."""
+
+import pytest
+
+from tendermint_tpu.crypto import backend as cb
+from tendermint_tpu.state.evidence import (EvidencePool, decode_evidence,
+                                           encode_evidence)
+from tendermint_tpu.types import TYPE_PREVOTE
+from tendermint_tpu.types.block import BlockID
+from tendermint_tpu.types.part_set import PartSetHeader
+from tendermint_tpu.types.vote import DuplicateVoteEvidence, Vote
+from tendermint_tpu.utils.db import MemDB
+
+from chainutil import make_validators
+
+CHAIN = "ev-chain"
+
+
+@pytest.fixture(autouse=True)
+def _backend():
+    cb.set_backend("python")
+
+
+def _vote(priv, vs, h, block_hash):
+    idx = vs.index_of(priv.address)
+    bid = BlockID(block_hash, PartSetHeader(1, b"\x01" * 32))
+    v = Vote(validator_address=priv.address, validator_index=idx,
+             height=h, round=0, type=TYPE_PREVOTE, block_id=bid)
+    sig = priv.priv_key.sign(v.sign_bytes(CHAIN))
+    return Vote(**{**v.__dict__, "signature": sig})
+
+
+def test_add_verify_dedup_persist():
+    privs, vs = make_validators(4)
+    db = MemDB()
+    pool = EvidencePool(db, CHAIN)
+    ev = DuplicateVoteEvidence(_vote(privs[0], vs, 5, b"\xaa" * 32),
+                               _vote(privs[0], vs, 5, b"\xbb" * 32))
+    assert pool.add(ev, vs)
+    assert not pool.add(ev, vs)           # dedup
+    assert pool.size() == 1
+    # codec roundtrip
+    assert decode_evidence(encode_evidence(ev)).vote_a == ev.vote_a
+    # persistence: a new pool over the same db reloads it
+    pool2 = EvidencePool(db, CHAIN)
+    assert pool2.size() == 1
+    assert pool2.pending()[0].vote_b.block_id.hash == b"\xbb" * 32
+
+
+def test_rejects_fabricated_evidence():
+    privs, vs = make_validators(4)
+    other_privs, other_vs = make_validators(4, seed=9)
+    pool = EvidencePool(MemDB(), CHAIN)
+    # accused not in set
+    ev = DuplicateVoteEvidence(
+        _vote(other_privs[0], other_vs, 3, b"\xaa" * 32),
+        _vote(other_privs[0], other_vs, 3, b"\xbb" * 32))
+    assert not pool.add(ev, vs)
+    # forged signature on one vote
+    va = _vote(privs[1], vs, 3, b"\xaa" * 32)
+    vb = _vote(privs[1], vs, 3, b"\xbb" * 32)
+    forged = Vote(**{**vb.__dict__, "signature": b"\x00" * 64})
+    assert not pool.add(DuplicateVoteEvidence(va, forged), vs)
+    # agreeing votes are not equivocation
+    assert not pool.add(DuplicateVoteEvidence(va, va), vs)
+    assert pool.size() == 0
+
+
+def test_node_captures_evidence_into_pool():
+    """The byzantine reactor test asserts the event fires; here the node
+    wiring must land it in the pool and serve it over RPC."""
+    import time
+    from tendermint_tpu.config import test_config as fast_config
+    from tendermint_tpu.node.node import Node
+    from tendermint_tpu.rpc.routes import Routes
+    from tendermint_tpu.types import (GenesisDoc, GenesisValidator,
+                                      PrivKey, PrivValidator)
+    pv = PrivValidator(PrivKey(b"\x33" * 32))
+    gen = GenesisDoc(chain_id="evn-chain",
+                     validators=[GenesisValidator(pv.pub_key.bytes_, 10)],
+                     genesis_time_ns=1)
+    cfg = fast_config()
+    cfg.rpc.laddr = ""
+    cfg.p2p.laddr = ""
+    n = Node(cfg, priv_validator=pv, genesis_doc=gen)
+    n.start()
+    try:
+        deadline = time.time() + 20
+        while time.time() < deadline and n.block_store.height < 1:
+            time.sleep(0.01)
+        vs = n.consensus.state.validators
+        h = n.consensus.height + 100    # future height: no interference
+        idx = vs.index_of(pv.address)
+
+        def mk(bh):
+            from tendermint_tpu.types.block import BlockID
+            bid = BlockID(bh, PartSetHeader(1, b"\x01" * 32))
+            v = Vote(validator_address=pv.address, validator_index=idx,
+                     height=h, round=0, type=TYPE_PREVOTE, block_id=bid)
+            sig = pv.priv_key.sign(v.sign_bytes("evn-chain"))
+            return Vote(**{**v.__dict__, "signature": sig})
+
+        ev = DuplicateVoteEvidence(mk(b"\xaa" * 32), mk(b"\xbb" * 32))
+        n.evsw.fire("EvidenceDoubleSign", ev)
+        deadline = time.time() + 5
+        while time.time() < deadline and n.evidence_pool.size() == 0:
+            time.sleep(0.01)
+        assert n.evidence_pool.size() == 1
+        out = Routes(n).evidence({})
+        assert out["count"] == 1
+        assert out["evidence"][0]["vote_a"]["height"] == h
+    finally:
+        n.stop()

@@ -225,3 +225,30 @@ def test_fast_sync_evicts_lying_peer():
     finally:
         for sw in (liar_sw, honest_sw, sync_sw):
             sw.stop()
+
+
+def test_fast_sync_verify_ahead_overlap():
+    """With several windows queued, the reactor must consume speculative
+    lookahead verifications (device verify of window k+1 overlapping the
+    apply of window k) and still land byte-identical state."""
+    privs, vs = make_validators(4)
+    gen = make_genesis(CHAIN, privs)
+    n = 40
+    hashes = kvstore_app_hashes(n)
+    chain = build_chain(privs, vs, CHAIN, n, app_hashes=hashes)
+    src_sw, src_state, src_store = _source_node(chain, gen)
+    sync_sw, bc, cons, sync_store = _sync_node(gen, batch_size=4)
+    src_sw.start(); sync_sw.start()
+    try:
+        connect_switches(sync_sw, src_sw)
+        deadline = time.time() + 30
+        while sync_store.height < n - 1 and time.time() < deadline:
+            time.sleep(0.02)
+        assert sync_store.height >= n - 1, bc.pool.status()
+        assert bc.lookahead_hits >= 1, "speculative windows never consumed"
+        for h in range(1, n - 1):
+            assert sync_store.load_block(h).hash() == \
+                src_store.load_block(h).hash()
+        assert bc.state.app_hash == hashes[n - 1]
+    finally:
+        src_sw.stop(); sync_sw.stop()

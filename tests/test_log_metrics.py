@@ -82,3 +82,20 @@ def test_backend_updates_global_metrics():
     finally:
         cb._current = old
     assert metrics.REGISTRY.sigs_requested.value == before + 1
+
+
+def test_debug_stacks_and_trace_hooks():
+    """pprof-analog debug surface: thread stacks + device trace guards."""
+    from tendermint_tpu.utils import trace
+    stacks = trace.thread_stacks()
+    assert any("MainThread" in k for k in stacks)
+    assert any("test_debug_stacks" in "".join(v) for v in stacks.values())
+    # double-start is refused; stop returns the dir once
+    import tempfile
+    d = tempfile.mkdtemp()
+    assert trace.start_device_trace(d)
+    try:
+        assert not trace.start_device_trace(d)
+    finally:
+        assert trace.stop_device_trace() == d
+    assert trace.stop_device_trace() is None

@@ -1,0 +1,28 @@
+"""Native C++ merkle engine vs the host reference implementation."""
+
+import numpy as np
+import pytest
+
+from tendermint_tpu.types import merkle as host
+from tendermint_tpu.utils import nativelib
+
+pytestmark = pytest.mark.skipif(nativelib.get() is None,
+                                reason="native toolchain unavailable")
+
+
+def test_leaf_hashes_match_host():
+    rng = np.random.default_rng(0)
+    msgs = rng.integers(0, 256, (100, 77), dtype=np.uint8)
+    got = nativelib.leaf_hashes(msgs)
+    for i in range(100):
+        assert got[i].tobytes() == host.leaf_hash(msgs[i].tobytes())
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 7, 16, 100])
+def test_merkle_roots_match_host(n):
+    rng = np.random.default_rng(n)
+    leaves = rng.integers(0, 256, (4, n, 33), dtype=np.uint8)
+    got = nativelib.merkle_roots(leaves)
+    for t in range(4):
+        want = host.root([leaves[t, i].tobytes() for i in range(n)])
+        assert got[t].tobytes() == want
