@@ -121,6 +121,11 @@ void prefixed_hash(uint8_t prefix, const uint8_t* a, size_t alen,
 // reference-shaped tree over precomputed leaf hashes [n][32] (scratch
 // must hold n*32 bytes); writes the root to out.
 void tree_root(uint8_t* hashes, size_t n, uint8_t* out) {
+  if (n == 0) {  // empty tree: sha256("") — matches the host merkle.root
+    Sha256 s;
+    s.final(out);
+    return;
+  }
   // plain recursion on the (n+1)/2 split; depth <= log2(n) + 1
   struct Rec {
     uint8_t* hs;

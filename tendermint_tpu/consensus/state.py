@@ -230,11 +230,11 @@ class ConsensusState:
                                                 if pc else None),
                     }
             out["votes"] = votes
-            out["validators"] = {
+            prop = self.validators._proposer   # may be None mid-update;
+            out["validators"] = {              # a debug dump must not trip
                 "size": self.validators.size(),
                 "total_power": self.validators.total_voting_power(),
-                "proposer": self.validators.proposer.address.hex()
-                if self.validators.validators else None,
+                "proposer": prop.address.hex() if prop is not None else None,
             }
             lc = self.last_commit
             out["last_commit"] = (bits(lc.bit_array())

@@ -87,6 +87,7 @@ class TpuBackend:
 
     def __init__(self):
         # import lazily so the python backend works without jax configured
+        import jax
         import jax.numpy as jnp
         from tendermint_tpu.ops import ed25519 as dev
         _enable_compile_cache()
@@ -95,6 +96,15 @@ class TpuBackend:
         self._tables: dict[bytes, tuple] = {}   # set_key -> (tbl, ok, V)
         self._tables_lock = threading.Lock()
         self._builds: dict[bytes, threading.Event] = {}  # in-flight builds
+        # multi-chip: shard verify lanes over every visible device (comb
+        # tables replicate; no collectives in the hot loop).  Single-chip
+        # hosts skip the sharding machinery entirely.
+        self._mesh = None
+        self._sharded_fns: dict[bytes, object] = {}
+        n_dev = len(jax.devices())
+        if n_dev > 1:
+            from tendermint_tpu.parallel import sharding
+            self._mesh = sharding.make_mesh(n_dev)
 
     def verify_batch(self, pubkeys, msgs, sigs):
         n = len(pubkeys)
@@ -149,6 +159,16 @@ class TpuBackend:
                 [val_pubs, np.repeat(val_pubs[:1], vb - v, 0)])
         t0 = time.perf_counter()
         tbl, ok = self._dev.build_neg_comb_jit(self._jnp.asarray(val_pubs))
+        if self._mesh is not None:
+            # commit the tables replicated across the mesh at build time:
+            # the sharded verify takes them as arguments (one jitted fn
+            # per SHAPE, not per set), so evicting the table entry also
+            # frees its only replicated device copy
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            repl = NamedSharding(self._mesh, P())
+            tbl = jax.device_put(tbl, repl)
+            ok = jax.device_put(ok, repl)
         tbl.block_until_ready()
         REGISTRY.table_build_seconds.observe(time.perf_counter() - t0)
         ent = (tbl, ok, v)
@@ -173,6 +193,23 @@ class TpuBackend:
             sigs = np.zeros((n, 64), dtype=np.uint8)
             self.verify_grouped(set_key, val_pubs, idx, msgs, sigs)
 
+    # below this many lanes per device the sharded dispatch overhead
+    # beats the parallelism (single gossiped votes stay single-device)
+    MIN_LANES_PER_DEVICE = 1024
+
+    def _sharded_fn(self, v_bucket: int, msg_len: int):
+        """Jitted mesh verify, one per SHAPE (tables are arguments)."""
+        key = (v_bucket, msg_len)
+        with self._tables_lock:
+            fn = self._sharded_fns.get(key)
+        if fn is None:
+            from tendermint_tpu.parallel import sharding
+            fn = sharding.sharded_grouped_verify_fn(self._mesh)
+            with self._tables_lock:
+                self._sharded_fns.setdefault(key, fn)
+                fn = self._sharded_fns[key]
+        return fn
+
     def verify_grouped(self, set_key, val_pubs, val_idx, msgs, sigs):
         n = len(val_idx)
         if n == 0:
@@ -192,9 +229,16 @@ class TpuBackend:
             sigs = np.concatenate([sigs, np.repeat(sigs[:1], pad, 0)])
         jnp = self._jnp
         t0 = time.perf_counter()
-        out = self._dev.verify_grouped_jit(
-            tbl, pub_ok, jnp.asarray(val_idx.astype(np.int32)),
-            jnp.asarray(pubkeys), jnp.asarray(msgs), jnp.asarray(sigs))
+        n_dev = (self._mesh.devices.size if self._mesh is not None else 1)
+        if (self._mesh is not None and b % n_dev == 0 and
+                b >= self.MIN_LANES_PER_DEVICE * n_dev):
+            fn = self._sharded_fn(tbl.shape[2], msgs.shape[-1])
+            out = fn(tbl, pub_ok, val_idx.astype(np.int32), pubkeys,
+                     msgs, sigs)
+        else:
+            out = self._dev.verify_grouped_jit(
+                tbl, pub_ok, jnp.asarray(val_idx.astype(np.int32)),
+                jnp.asarray(pubkeys), jnp.asarray(msgs), jnp.asarray(sigs))
         out = np.asarray(out)
         REGISTRY.device_step_seconds.observe(time.perf_counter() - t0)
         REGISTRY.sigs_requested.inc(n)

@@ -133,19 +133,13 @@ class Node:
             return
         from tendermint_tpu.blockchain.reactor import DEFAULT_BATCH
 
-        def bucket(n):
-            b = cb.MIN_BUCKET
-            while b < n:
-                b *= 2
-            return b
-
         vals = self.consensus.state.validators
         v = max(vals.size(), 1)
         # the lane counts this node will actually produce: a single
         # gossiped vote (MIN_BUCKET), one commit (V lanes), and a full
         # fast-sync verify window (DEFAULT_BATCH blocks x V lanes)
-        buckets = sorted({cb.MIN_BUCKET, bucket(v),
-                          bucket(DEFAULT_BATCH * v)})
+        buckets = sorted({cb.MIN_BUCKET, cb._bucket(v),
+                          cb._bucket(DEFAULT_BATCH * v)})
 
         def warm():
             try:

@@ -101,3 +101,25 @@ def training_step_fn(mesh: Mesh, msg_len: int, axis: str = "batch"):
         step,
         in_shardings=(shard, shard, shard, shard, shard, None),
         out_shardings=(shard, shard, shard))
+
+
+def sharded_grouped_verify_fn(mesh: Mesh, axis: str = "batch"):
+    """Grouped verify over a mesh: lanes sharded, comb tables replicated.
+
+    The table for a validator set is identical on every chip (the fixed
+    keys), so only the (val_idx, pubkeys, msgs, sigs) lanes split across
+    the mesh — each chip runs the 32-add comb path on its shard with NO
+    collectives in the hot loop (the bool gather at the end rides ICI).
+    Tables arrive as ARGUMENTS (already replicated/committed at build
+    time by the backend) so one jitted fn per shape serves every
+    validator set.  This is how `crypto.backend.TpuBackend` scales the
+    verification grid when more than one device is visible — the
+    framework's analog of the reference scaling by gossiping to more
+    peers.
+    """
+    shard = NamedSharding(mesh, P(axis))
+    repl = NamedSharding(mesh, P())
+    return jax.jit(
+        _ed.verify_grouped,
+        in_shardings=(repl, repl, shard, shard, shard, shard),
+        out_shardings=shard)

@@ -84,6 +84,13 @@ class Routes:
             "debug_trace_start": self.debug_trace_start,
             "debug_trace_stop": self.debug_trace_stop,
         }
+        if getattr(node.config.rpc, "unsafe", False):
+            # operator-only routes, served only with rpc.unsafe = true
+            # (reference rpc/core/routes.go:30-36 AddUnsafeRoutes)
+            self.table.update({
+                "unsafe_flush_mempool": self.unsafe_flush_mempool,
+                "unsafe_dial_seeds": self.unsafe_dial_seeds,
+            })
 
     # -- info routes ----------------------------------------------------
     def status(self, params: dict) -> dict:
@@ -183,6 +190,23 @@ class Routes:
         return {"count": len(evs),
                 "evidence": [{"vote_a": vote_d(e.vote_a),
                               "vote_b": vote_d(e.vote_b)} for e in evs]}
+
+    # -- unsafe operator routes (reference rpc/core/routes.go:30-36) ------
+    def unsafe_flush_mempool(self, params: dict) -> dict:
+        self.node.mempool.flush()
+        return {"flushed": True}
+
+    def unsafe_dial_seeds(self, params: dict) -> dict:
+        from tendermint_tpu.p2p.types import NetAddress
+        seeds = params.get("seeds") or []
+        if isinstance(seeds, str):
+            seeds = [s for s in seeds.split(",") if s]
+        sw = self.node.switch
+        if sw is None:
+            raise ValueError("node has no p2p switch")
+        for s in seeds:
+            sw.dial_peer_async(NetAddress.parse(str(s)))
+        return {"dialing": list(map(str, seeds))}
 
     # -- debug/profiling routes (reference pprof endpoints analog) --------
     def debug_stacks(self, params: dict) -> dict:

@@ -102,8 +102,17 @@ def test_invalid_pubkey_in_set():
 
 
 def test_backend_grouped_matches_batch_and_caches():
+    """Under conftest's 8 virtual CPU devices this also exercises the
+    MESH path: the backend shards lanes across all visible devices with
+    replicated comb tables, and must agree lane-wise with the
+    single-device kernel (verify_batch below).  The per-device lane
+    threshold is forced down so the 16-lane batch rides the mesh."""
+    import jax
     from tendermint_tpu.crypto import backend as cb
     be = cb.TpuBackend()
+    assert len(jax.devices()) == 8
+    assert be._mesh is not None and be._mesh.devices.size == 8
+    be.MIN_LANES_PER_DEVICE = 2      # 16 lanes / 8 devices
     seeds = [secrets.token_bytes(32) for _ in range(V)]
     pubs = [ref.pubkey_from_seed(s) for s in seeds]
     vp = np.frombuffer(b"".join(pubs), np.uint8).reshape(V, 32)

@@ -133,3 +133,20 @@ def test_status_reports_live_state(node, client):
     assert after["latest_block_height"] > before["latest_block_height"]
     assert after["latest_app_hash"] != before["latest_app_hash"]
     assert after["latest_app_hash"] == node.consensus.state.app_hash.hex()
+
+
+def test_unsafe_routes_gated(node, client):
+    """unsafe_* routes exist only when rpc.unsafe is set (reference
+    AddUnsafeRoutes, rpc/core/routes.go:30-36)."""
+    from tendermint_tpu.rpc.routes import Routes
+    with pytest.raises(RPCError):
+        client.call("unsafe_flush_mempool")
+    node.config.rpc.unsafe = True
+    try:
+        r = Routes(node)
+        assert "unsafe_flush_mempool" in r.table
+        node.mempool.check_tx(b"zz=1")
+        assert r.unsafe_flush_mempool({})["flushed"]
+        assert node.mempool.size() == 0
+    finally:
+        node.config.rpc.unsafe = False
