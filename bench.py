@@ -174,7 +174,7 @@ def config1_batch_verify(quick: bool, sizes=None) -> dict:
             # device-resident: inputs staged (as when the batch is already
             # on device from the pipeline's previous stage) — the raw
             # batch-verify throughput this config is defined to measure
-            tbl, pub_ok, _ = backend._set_tables(set_key, val_pubs)
+            tbl, pub_ok, _, _ = backend._set_tables(set_key, val_pubs)
             staged = [
                 tuple(map(jnp.asarray, (val_idx, val_pubs[val_idx],
                                         b[1], b[2])))
@@ -282,7 +282,8 @@ def _replay_chain(n_vals: int, n_blocks: int, backend: str,
     from tendermint_tpu.proxy import ClientCreator
     from tendermint_tpu.types import BlockID
     from tendermint_tpu.types.validator import (CommitPowerError,
-                                                CommitSignatureError)
+                                                CommitSignatureError,
+                                                merge_commit_lanes)
     from tendermint_tpu.utils.db import MemDB
 
     if window is None:
@@ -303,25 +304,28 @@ def _replay_chain(n_vals: int, n_blocks: int, backend: str,
     total_power = vals.total_voting_power()
 
     def _prep(blocks):
-        """Stage 1: part-set re-hash + lane assembly (host)."""
-        items, arrays = [], []
+        """Stage 1: part-set re-hash + lane assembly (host).  Lanes are
+        the TEMPLATED form: ~1 message template per block plus per-lane
+        (sig, validator index, template index) — the device assembles
+        messages and gathers pubkeys itself, so the host ships 72 B/lane
+        instead of 228 B."""
+        items, lanes = [], []
         for block, _, seen in blocks:
             parts = block.make_part_set()       # re-hash like fast-sync
             bid = BlockID(block.hash(), parts.header)
             items.append((bid, block.height, seen, parts))
-            arrays.append(vals.commit_verify_arrays(chain_id, bid,
-                                                    block.height, seen))
-        msgs = np.concatenate([a[1] for a in arrays])
-        sigs = np.concatenate([a[2] for a in arrays])
-        idxs = np.concatenate([a[4] for a in arrays])
-        return items, arrays, msgs, sigs, idxs
+            lanes.append(vals.commit_verify_lanes(chain_id, bid,
+                                                  block.height, seen))
+        templates, tmpl_idx, sigs, idxs = merge_commit_lanes(lanes)
+        return items, lanes, templates, tmpl_idx, sigs, idxs
 
-    def _verify(items, arrays, msgs, sigs, idxs):
+    def _verify(items, lanes, templates, tmpl_idx, sigs, idxs):
         """Stage 2: one grouped device batch + per-commit tallies."""
-        ok = cb.verify_grouped(set_key, pubs_mat, idxs, msgs, sigs)
+        ok = cb.verify_grouped_templated(set_key, pubs_mat, idxs,
+                                         tmpl_idx, templates, sigs)
         off = 0
-        for (bid, h, _, _), a in zip(items, arrays):
-            n = len(a[0])
+        for (bid, h, _, _), a in zip(items, lanes):
+            n = len(a[4])
             if not ok[off:off + n].all():
                 raise CommitSignatureError(
                     h, int(np.argmin(ok[off:off + n])))

@@ -183,6 +183,51 @@ def cmd_replay(args) -> int:
     return 0
 
 
+def cmd_replay_console(args) -> int:
+    """Interactive WAL stepper (reference `consensus/replay.go` console:
+    inspect every journalled consensus input one record at a time).
+
+    Commands: <enter>/n = next record, d = dump decoded payload,
+    q = quit.  Non-tty stdin steps through everything (scriptable).
+    """
+    import struct
+    from tendermint_tpu.consensus import messages as M
+    from tendermint_tpu.consensus.wal import (REC_ENDHEIGHT, REC_MESSAGE,
+                                              REC_TIMEOUT, WAL)
+    cfg = _load_config(args)
+    wal_path = os.path.join(cfg.base.db_dir(), "cs.wal")
+    recs = WAL.read_all(wal_path)
+    print(f"{len(recs)} records in {wal_path}")
+    interactive = sys.stdin.isatty()
+    for i, (kind, payload) in enumerate(recs):
+        if kind == REC_ENDHEIGHT:
+            desc = f"ENDHEIGHT {struct.unpack('>Q', payload)[0]}"
+        elif kind == REC_TIMEOUT:
+            h, r, s = struct.unpack(">QIB", payload)
+            desc = f"TIMEOUT h={h} r={r} step={s}"
+        elif kind == REC_MESSAGE:
+            try:
+                desc = f"MESSAGE {type(M.decode_msg(payload)).__name__}"
+            except Exception:
+                desc = f"MESSAGE <undecodable {len(payload)}B>"
+        else:
+            desc = f"kind={kind} ({len(payload)}B)"
+        print(f"[{i}] {desc}")
+        if interactive:
+            cmdline = input("(n)ext / (d)ump / (q)uit> ").strip().lower()
+            if cmdline == "q":
+                break
+            if cmdline == "d":
+                if kind == REC_MESSAGE:
+                    try:
+                        print("   ", M.decode_msg(payload))
+                    except Exception as e:
+                        print("    undecodable:", e)
+                else:
+                    print("   ", payload.hex())
+    return 0
+
+
 def cmd_version(args) -> int:
     print(__version__)
     return 0
@@ -226,6 +271,10 @@ def main(argv=None) -> int:
 
     sp = sub.add_parser("unsafe_reset_all", help="wipe data dir")
     sp.set_defaults(fn=cmd_unsafe_reset_all)
+
+    sp = sub.add_parser("replay_console",
+                        help="step through the consensus WAL")
+    sp.set_defaults(fn=cmd_replay_console)
 
     sp = sub.add_parser("replay", help="replay blocks into the app")
     sp.add_argument("--proxy-app", dest="proxy_app", default="")

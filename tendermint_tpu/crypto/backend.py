@@ -158,8 +158,9 @@ class TpuBackend:
             val_pubs = np.concatenate(
                 [val_pubs, np.repeat(val_pubs[:1], vb - v, 0)])
         t0 = time.perf_counter()
-        tbl, ok = self._dev.build_neg_comb_jit(self._jnp.asarray(val_pubs))
-        if self._mesh is not None:
+        vp_dev = self._jnp.asarray(val_pubs)   # one upload serves both the
+        tbl, ok = self._dev.build_neg_comb_jit(vp_dev)  # build and lane
+        if self._mesh is not None:             # pubkey gathers
             # commit the tables replicated across the mesh at build time:
             # the sharded verify takes them as arguments (one jitted fn
             # per SHAPE, not per set), so evicting the table entry also
@@ -169,14 +170,59 @@ class TpuBackend:
             repl = NamedSharding(self._mesh, P())
             tbl = jax.device_put(tbl, repl)
             ok = jax.device_put(ok, repl)
+            vp_dev = jax.device_put(vp_dev, repl)
         tbl.block_until_ready()
         REGISTRY.table_build_seconds.observe(time.perf_counter() - t0)
-        ent = (tbl, ok, v)
+        ent = (tbl, ok, v, vp_dev)
         with self._tables_lock:
             while len(self._tables) >= self.TABLE_CACHE_SETS:
                 self._tables.pop(next(iter(self._tables)))
             self._tables[set_key] = ent
         return ent
+
+    def verify_grouped_templated(self, set_key, val_pubs, val_idx,
+                                 tmpl_idx, templates, sigs):
+        """Grouped verify shipping only (sig, val_idx, tmpl_idx) lanes
+        plus T message templates; messages and pubkeys assemble on
+        device (see ops.ed25519.verify_grouped_templated)."""
+        n = len(val_idx)
+        if n == 0:
+            return np.zeros(0, dtype=bool)
+        tbl, pub_ok, v, vp_dev = self._set_tables(set_key, val_pubs)
+        if v != len(val_pubs):
+            raise ValueError(
+                f"set_key reused for a different set size ({v} != "
+                f"{len(val_pubs)})")
+        b = _bucket(n)
+        if self._mesh_eligible(b):
+            # mesh path: assemble messages host-side and ride the
+            # sharded kernel (templates are tiny; the win is moot there)
+            return self.verify_grouped(set_key, val_pubs, val_idx,
+                                       templates[tmpl_idx], sigs)
+        pad = b - n
+        if pad:
+            val_idx = np.concatenate([val_idx, np.repeat(val_idx[:1], pad)])
+            tmpl_idx = np.concatenate([tmpl_idx,
+                                       np.repeat(tmpl_idx[:1], pad)])
+            sigs = np.concatenate([sigs, np.repeat(sigs[:1], pad, 0)])
+        t = len(templates)
+        tb = _bucket(t)
+        if tb > t:
+            templates = np.concatenate(
+                [templates, np.zeros((tb - t, templates.shape[1]),
+                                     np.uint8)])
+        jnp = self._jnp
+        t0 = time.perf_counter()
+        out = np.asarray(self._dev.verify_grouped_templated_jit(
+            tbl, pub_ok, vp_dev, jnp.asarray(val_idx.astype(np.int32)),
+            jnp.asarray(tmpl_idx.astype(np.int32)),
+            jnp.asarray(templates), jnp.asarray(sigs)))
+        REGISTRY.device_step_seconds.observe(time.perf_counter() - t0)
+        REGISTRY.sigs_requested.inc(n)
+        REGISTRY.sigs_verified.inc(int(out[:n].sum()))
+        REGISTRY.verify_batches.inc()
+        REGISTRY.batch_occupancy.observe(n / b)
+        return out[:n]
 
     def precompile(self, set_key: bytes, val_pubs: np.ndarray,
                    lane_buckets: list[int], msg_len: int) -> None:
@@ -191,11 +237,26 @@ class TpuBackend:
             idx = (np.arange(n) % n_vals).astype(np.int32)
             msgs = np.zeros((n, msg_len), dtype=np.uint8)
             sigs = np.zeros((n, 64), dtype=np.uint8)
+            # the plain path serves VoteSet.add_votes_batched ...
             self.verify_grouped(set_key, val_pubs, idx, msgs, sigs)
+            # ... and the templated path serves verify_commit /
+            # fast-sync windows (~n/V message templates per n lanes)
+            t = max(1, n // max(n_vals, 1))
+            self.verify_grouped_templated(
+                set_key, val_pubs, idx,
+                (np.arange(n) % t).astype(np.int32),
+                np.zeros((t, msg_len), dtype=np.uint8), sigs)
 
     # below this many lanes per device the sharded dispatch overhead
     # beats the parallelism (single gossiped votes stay single-device)
     MIN_LANES_PER_DEVICE = 1024
+
+    def _mesh_eligible(self, bucket: int) -> bool:
+        if self._mesh is None:
+            return False
+        n_dev = self._mesh.devices.size
+        return (bucket % n_dev == 0 and
+                bucket >= self.MIN_LANES_PER_DEVICE * n_dev)
 
     def _sharded_fn(self, v_bucket: int, msg_len: int):
         """Jitted mesh verify, one per SHAPE (tables are arguments)."""
@@ -214,7 +275,7 @@ class TpuBackend:
         n = len(val_idx)
         if n == 0:
             return np.zeros(0, dtype=bool)
-        tbl, pub_ok, v = self._set_tables(set_key, val_pubs)
+        tbl, pub_ok, v, _ = self._set_tables(set_key, val_pubs)
         if v != len(val_pubs):       # stale key reuse would verify against
             raise ValueError(        # the wrong table — refuse loudly
                 f"set_key reused for a different set size ({v} != "
@@ -229,9 +290,7 @@ class TpuBackend:
             sigs = np.concatenate([sigs, np.repeat(sigs[:1], pad, 0)])
         jnp = self._jnp
         t0 = time.perf_counter()
-        n_dev = (self._mesh.devices.size if self._mesh is not None else 1)
-        if (self._mesh is not None and b % n_dev == 0 and
-                b >= self.MIN_LANES_PER_DEVICE * n_dev):
+        if self._mesh_eligible(b):
             fn = self._sharded_fn(tbl.shape[2], msgs.shape[-1])
             out = fn(tbl, pub_ok, val_idx.astype(np.int32), pubkeys,
                      msgs, sigs)
@@ -331,3 +390,16 @@ def verify_grouped(set_key: bytes, val_pubs, val_idx, msgs,
     if fn is None:
         return be.verify_batch(val_pubs[val_idx], msgs, sigs)
     return fn(set_key, val_pubs, val_idx, msgs, sigs)
+
+
+def verify_grouped_templated(set_key: bytes, val_pubs, val_idx, tmpl_idx,
+                             templates, sigs) -> np.ndarray:
+    """Template form: lane i's message is templates[tmpl_idx[i]].  Device
+    backends ship only indices + sigs and assemble on device; others
+    gather host-side (one cheap numpy take) and batch normally."""
+    be = get_backend()
+    fn = getattr(be, "verify_grouped_templated", None)
+    if fn is not None:
+        return fn(set_key, val_pubs, val_idx, tmpl_idx, templates, sigs)
+    return verify_grouped(set_key, val_pubs, val_idx,
+                          templates[tmpl_idx], sigs)

@@ -237,6 +237,25 @@ class ValidatorSet:
         for precommits voting a different block (verified but not tallied)
         and idxs[i] the signer's validator index (grouped-verify lane map).
         A structural error in any precommit raises ValueError.
+
+        Derived from `commit_verify_lanes` — the per-vote validation
+        lives in exactly one place — by expanding the message templates.
+        """
+        templates, tmpl_idx, sigs, powers, idxs = self.commit_verify_lanes(
+            chain_id, block_id, height, commit)
+        return (self.pubs_matrix()[idxs], templates[tmpl_idx], sigs,
+                powers, idxs)
+
+    def commit_verify_lanes(self, chain_id: str, block_id, height: int,
+                            commit) -> tuple:
+        """Template form of `commit_verify_arrays`: vote sign-bytes do
+        not include the signer, so lanes voting the same block share ONE
+        128-byte message — a commit compresses to ~1 template plus
+        per-lane (sig, validator index, template index).  Device backends
+        ship only the indices and assemble messages on device.
+
+        Returns (templates[T,128], tmpl_idx[N], sigs[N,64], powers[N],
+        idxs[N]).
         """
         if self.size() != commit.size():
             raise ValueError(
@@ -244,8 +263,10 @@ class ValidatorSet:
         if commit.height() != height:
             raise ValueError(f"commit height {commit.height()} != {height}")
         round_ = commit.round()
-        votes, sigs, powers, idxs = [], [], [], []
         bid_key = block_id.key()
+        tmpl_of: dict[tuple, int] = {}
+        templates: list[bytes] = []
+        tmpl_idx, sigs, powers, idxs = [], [], [], []
         for idx, v in enumerate(commit.precommits):
             if v is None:
                 continue
@@ -258,42 +279,28 @@ class ValidatorSet:
             if v.height != height or v.round != round_:
                 raise ValueError(f"commit vote {idx} wrong height/round")
             if v.validator_index != idx:
-                raise ValueError(f"commit vote index {v.validator_index}!={idx}")
+                raise ValueError(
+                    f"commit vote index {v.validator_index}!={idx}")
             val = self.validators[idx]
             if val.address != v.validator_address:
                 raise ValueError(f"commit vote {idx} address mismatch")
-            votes.append(v)
+            vkey = v.block_id.key()
+            ti = tmpl_of.get(vkey)
+            if ti is None:
+                ti = tmpl_of[vkey] = len(templates)
+                templates.append(v.sign_bytes(chain_id))
+            tmpl_idx.append(ti)
             sigs.append(v.signature)
-            powers.append(val.voting_power
-                          if v.block_id.key() == bid_key else 0)
+            powers.append(val.voting_power if vkey == bid_key else 0)
             idxs.append(idx)
-        n = len(votes)
-        idx_arr = np.asarray(idxs, dtype=np.int32)
-        # vectorized sign-bytes assembly (no per-vote Python packing):
-        # validate_basic pinned hash lengths to 0 or 32, so ljust-padding
-        # nil hashes with zeros matches the scalar writer exactly
-        msgs = canonical.batch_sign_bytes(
-            chain_id,
-            np.full(n, canonical.TYPE_PRECOMMIT, dtype=np.uint8),
-            np.full(n, height, dtype=np.uint64),
-            np.full(n, round_, dtype=np.uint32),
-            np.frombuffer(b"".join(v.block_id.hash.ljust(32, b"\x00")
-                                   for v in votes),
-                          np.uint8).reshape(n, 32) if n else
-            np.zeros((0, 32), np.uint8),
-            np.frombuffer(b"".join(v.block_id.parts.hash.ljust(32, b"\x00")
-                                   for v in votes),
-                          np.uint8).reshape(n, 32) if n else
-            np.zeros((0, 32), np.uint8),
-            np.asarray([v.block_id.parts.total for v in votes],
-                       dtype=np.uint32),
-        )
+        n = len(idxs)
         return (
-            self.pubs_matrix()[idx_arr],
-            msgs,
+            np.frombuffer(b"".join(templates), np.uint8).reshape(
+                len(templates), canonical.SIGN_BYTES_LEN),
+            np.asarray(tmpl_idx, dtype=np.int32),
             np.frombuffer(b"".join(sigs), np.uint8).reshape(n, 64),
             np.asarray(powers, dtype=np.int64),
-            idx_arr,
+            np.asarray(idxs, dtype=np.int32),
         )
 
     def verify_commit(self, chain_id: str, block_id, height: int,
@@ -302,10 +309,11 @@ class ValidatorSet:
         (reference `types/validator_set.go:220-264`); signatures checked in
         one crypto-backend batch against this set's cached comb tables."""
         from tendermint_tpu.crypto import backend as cb
-        pubs, msgs, sigs, powers, idxs = self.commit_verify_arrays(
+        templates, tmpl_idx, sigs, powers, idxs = self.commit_verify_lanes(
             chain_id, block_id, height, commit)
-        ok = cb.verify_grouped(self.set_key(), self.pubs_matrix(),
-                               idxs, msgs, sigs)
+        ok = cb.verify_grouped_templated(
+            self.set_key(), self.pubs_matrix(), idxs, tmpl_idx,
+            templates, sigs)
         if not ok.all():
             raise CommitSignatureError(height, int(np.argmin(ok)))
         tallied = int(powers.sum())
@@ -315,6 +323,21 @@ class ValidatorSet:
     def __str__(self):
         return (f"ValidatorSet[{self.size()} vals, "
                 f"power {self._total}]")
+
+
+def merge_commit_lanes(arrays: list[tuple]) -> tuple:
+    """Concatenate per-commit `commit_verify_lanes` tuples into one
+    device batch, rebasing each commit's template indices onto the
+    combined template block.  Returns (templates, tmpl_idx, sigs, idxs).
+    """
+    t_off, offs = 0, []
+    for a in arrays:
+        offs.append(t_off)
+        t_off += len(a[0])
+    return (np.concatenate([a[0] for a in arrays]),
+            np.concatenate([a[1] + o for a, o in zip(arrays, offs)]),
+            np.concatenate([a[2] for a in arrays]),
+            np.concatenate([a[4] for a in arrays]))
 
 
 def verify_commits_batched(val_set: ValidatorSet, chain_id: str,
@@ -332,14 +355,13 @@ def verify_commits_batched(val_set: ValidatorSet, chain_id: str,
     from tendermint_tpu.crypto import backend as cb
     if not items:
         return
-    arrays = [val_set.commit_verify_arrays(chain_id, bid, h, c)
+    arrays = [val_set.commit_verify_lanes(chain_id, bid, h, c)
               for bid, h, c in items]
-    counts = [len(a[0]) for a in arrays]
-    msgs = np.concatenate([a[1] for a in arrays])
-    sigs = np.concatenate([a[2] for a in arrays])
-    idxs = np.concatenate([a[4] for a in arrays])
-    ok = cb.verify_grouped(val_set.set_key(), val_set.pubs_matrix(),
-                           idxs, msgs, sigs)
+    counts = [len(a[4]) for a in arrays]
+    templates, tmpl_idx, sigs, idxs = merge_commit_lanes(arrays)
+    ok = cb.verify_grouped_templated(val_set.set_key(),
+                                     val_set.pubs_matrix(), idxs,
+                                     tmpl_idx, templates, sigs)
     off = 0
     total = val_set.total_voting_power()
     for (bid, h, _), a, n in zip(items, arrays, counts):

@@ -185,7 +185,7 @@ class VoteSet:
         from tendermint_tpu.crypto import backend as cb
         if not votes:
             return []
-        idxs, msgs, sigs, checkable = [], [], [], []
+        idxs, sel, sigs, checkable = [], [], [], []
         for i, v in enumerate(votes):
             try:
                 v.validate_basic()
@@ -197,18 +197,31 @@ class VoteSet:
                     self.val_set.validators[idx].address ==
                     v.validator_address):
                 idxs.append(idx)
-                msgs.append(v.sign_bytes(self.chain_id))
+                sel.append(v)
                 sigs.append(v.signature)
                 checkable.append(i)
         ok = np.zeros(len(votes), dtype=bool)
         if checkable:
-            # grouped verify: signer keys come from the validator set, so
-            # device backends reuse the set's cached comb tables
+            n = len(sel)
+            # vectorized sign-bytes assembly (validate_basic pinned hash
+            # lengths, so zero-padding nil hashes matches the scalar
+            # writer) + grouped verify against the set's cached tables
+            msgs = canonical.batch_sign_bytes(
+                self.chain_id,
+                np.full(n, self.type, dtype=np.uint8),
+                np.full(n, self.height, dtype=np.uint64),
+                np.full(n, self.round, dtype=np.uint32),
+                np.frombuffer(
+                    b"".join(v.block_id.hash.ljust(32, b"\x00")
+                             for v in sel), np.uint8).reshape(n, 32),
+                np.frombuffer(
+                    b"".join(v.block_id.parts.hash.ljust(32, b"\x00")
+                             for v in sel), np.uint8).reshape(n, 32),
+                np.asarray([v.block_id.parts.total for v in sel],
+                           dtype=np.uint32))
             res = cb.verify_grouped(
                 self.val_set.set_key(), self.val_set.pubs_matrix(),
-                np.asarray(idxs, dtype=np.int32),
-                np.frombuffer(b"".join(msgs), np.uint8).reshape(
-                    -1, canonical.SIGN_BYTES_LEN),
+                np.asarray(idxs, dtype=np.int32), msgs,
                 np.frombuffer(b"".join(sigs), np.uint8).reshape(-1, 64))
             ok[np.array(checkable)] = res
         out: list[bool | Exception] = []

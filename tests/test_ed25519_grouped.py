@@ -134,3 +134,30 @@ def test_backend_grouped_matches_batch_and_caches():
     # reusing a set_key for a different-sized set is refused
     with pytest.raises(ValueError):
         be.verify_grouped(b"set-a", vp[:2], idx % 2, ma, sa)
+
+
+def test_backend_templated_matches_plain():
+    """Device-side message assembly (templates + indices) must agree
+    lane-wise with the plain grouped path on valid and corrupted lanes,
+    including lanes sharing vs owning templates."""
+    from tendermint_tpu.crypto import backend as cb
+    be = cb.TpuBackend()
+    seeds = [secrets.token_bytes(32) for _ in range(V)]
+    pubs = [ref.pubkey_from_seed(s) for s in seeds]
+    vp = np.frombuffer(b"".join(pubs), np.uint8).reshape(V, 32)
+    # 3 templates: lanes map unevenly; all lanes of a template sign it
+    templates = np.frombuffer(
+        b"".join(secrets.token_bytes(MSG_LEN) for _ in range(3)),
+        np.uint8).reshape(3, MSG_LEN)
+    tmpl_idx = np.asarray([0, 0, 1, 2, 2, 2, 0, 1] * 2, np.int32)
+    idx = (np.arange(16) % V).astype(np.int32)
+    sigs = [ref.sign(seeds[idx[i]], templates[tmpl_idx[i]].tobytes())
+            for i in range(16)]
+    sigs[4] = sigs[5]                     # corrupt one lane
+    sa = np.frombuffer(b"".join(sigs), np.uint8).reshape(16, 64)
+    got = be.verify_grouped_templated(b"tmpl-set", vp, idx, tmpl_idx,
+                                      templates, sa)
+    want = be.verify_grouped(b"tmpl-set", vp, idx,
+                             templates[tmpl_idx], sa)
+    assert got.tolist() == want.tolist()
+    assert not got[4] and got[5]
