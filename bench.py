@@ -164,13 +164,29 @@ def config1_batch_verify(quick: bool, sizes=None) -> dict:
             if not ok.all():
                 raise RuntimeError("verify returned invalid lanes")
             # full path: host arrays in, host bools out (includes the
-            # host<->device transfer a node pays)
+            # host<->device transfer a node pays).  Votes at one height
+            # share a message, so the batch ships n//100 templates plus
+            # indices — the same templated form the node's commit
+            # verification uses.
+            tmpl_idx = (np.arange(n) // 100).astype(np.int32)
+            tmpls = [np.ascontiguousarray(b[1][::100]) for b in batches]
+            # warm the templated executable for THIS shape combo before
+            # the timed region (the first call above compiled the plain
+            # path only); also validates batch 0's templated lanes
+            ok0 = backend.verify_grouped_templated(
+                set_key, val_pubs, val_idx, tmpl_idx, tmpls[0],
+                batches[0][2])
+            if not ok0.all():
+                raise RuntimeError("templated verify returned bad lanes")
             reps, t0 = 4, time.perf_counter()
             for r in range(reps):
                 _, msgs, sigs, _, _ = batches[r % 2]
-                ok = backend.verify_grouped(set_key, val_pubs, val_idx,
-                                            msgs, sigs)
+                ok = backend.verify_grouped_templated(
+                    set_key, val_pubs, val_idx, tmpl_idx, tmpls[r % 2],
+                    sigs)
             steady = (time.perf_counter() - t0) / reps
+            if not ok.all():
+                raise RuntimeError("templated verify returned bad lanes")
             # device-resident: inputs staged (as when the batch is already
             # on device from the pipeline's previous stage) — the raw
             # batch-verify throughput this config is defined to measure

@@ -214,7 +214,10 @@ def cmd_replay_console(args) -> int:
             desc = f"kind={kind} ({len(payload)}B)"
         print(f"[{i}] {desc}")
         if interactive:
-            cmdline = input("(n)ext / (d)ump / (q)uit> ").strip().lower()
+            try:
+                cmdline = input("(n)ext / (d)ump / (q)uit> ").strip().lower()
+            except EOFError:        # Ctrl-D: exit like 'q'
+                break
             if cmdline == "q":
                 break
             if cmdline == "d":

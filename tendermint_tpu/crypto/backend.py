@@ -93,7 +93,8 @@ class TpuBackend:
         _enable_compile_cache()
         self._jnp = jnp
         self._dev = dev
-        self._tables: dict[bytes, tuple] = {}   # set_key -> (tbl, ok, V)
+        # set_key -> (tbl, ok, V, staged key matrix)
+        self._tables: dict[bytes, tuple] = {}
         self._tables_lock = threading.Lock()
         self._builds: dict[bytes, threading.Event] = {}  # in-flight builds
         # multi-chip: shard verify lanes over every visible device (comb
@@ -225,23 +226,26 @@ class TpuBackend:
         return out[:n]
 
     def precompile(self, set_key: bytes, val_pubs: np.ndarray,
-                   lane_buckets: list[int], msg_len: int) -> None:
+                   shapes: list[tuple[int, int]], msg_len: int) -> None:
         """Warm the comb tables for a validator set and the verify
-        executables for the standard lane buckets — a cold node joining a
-        net must not stall for a minute of XLA compile on its first
-        commit (the compiles also land in the persistent cache).  Run it
-        from a background thread at boot; every call is harmless dummy
-        work through the real entry points."""
+        executables for the standard (lanes, templates) shapes — a cold
+        node joining a net must not stall for a minute of XLA compile on
+        its first commit (the compiles also land in the persistent
+        cache).  Run it from a background thread at boot; every call is
+        harmless dummy work through the real entry points.  Template
+        counts must be the PRE-bucket values the real workload produces
+        (the jit shape is the bucketed count, derived identically here)."""
         n_vals = len(val_pubs)
-        for n in lane_buckets:
+        for n, t in shapes:
             idx = (np.arange(n) % n_vals).astype(np.int32)
-            msgs = np.zeros((n, msg_len), dtype=np.uint8)
             sigs = np.zeros((n, 64), dtype=np.uint8)
             # the plain path serves VoteSet.add_votes_batched ...
-            self.verify_grouped(set_key, val_pubs, idx, msgs, sigs)
+            self.verify_grouped(set_key, val_pubs, idx,
+                                np.zeros((n, msg_len), dtype=np.uint8),
+                                sigs)
             # ... and the templated path serves verify_commit /
-            # fast-sync windows (~n/V message templates per n lanes)
-            t = max(1, n // max(n_vals, 1))
+            # fast-sync windows
+            t = max(1, t)
             self.verify_grouped_templated(
                 set_key, val_pubs, idx,
                 (np.arange(n) % t).astype(np.int32),

@@ -135,19 +135,20 @@ class Node:
 
         vals = self.consensus.state.validators
         v = max(vals.size(), 1)
-        # the lane counts this node will actually produce: a single
-        # gossiped vote (MIN_BUCKET), one commit (V lanes), and a full
-        # fast-sync verify window (DEFAULT_BATCH blocks x V lanes)
-        buckets = sorted({cb.MIN_BUCKET, cb._bucket(v),
-                          cb._bucket(DEFAULT_BATCH * v)})
+        # the (lanes, templates) shapes this node will actually produce:
+        # a single gossiped vote, one commit (V lanes / 1 template), and
+        # a full fast-sync verify window (DEFAULT_BATCH blocks x V lanes,
+        # ~one template per block when commits are unanimous)
+        shapes = sorted({(cb.MIN_BUCKET, 1), (cb._bucket(v), 1),
+                         (cb._bucket(DEFAULT_BATCH * v), DEFAULT_BATCH)})
 
         def warm():
             try:
                 from tendermint_tpu.types import canonical
                 t0 = time.time()
-                be.precompile(vals.set_key(), vals.pubs_matrix(), buckets,
+                be.precompile(vals.set_key(), vals.pubs_matrix(), shapes,
                               canonical.SIGN_BYTES_LEN)
-                log.info("crypto precompile done", buckets=buckets,
+                log.info("crypto precompile done", shapes=shapes,
                          seconds=round(time.time() - t0, 1))
             except Exception:
                 log.exception("crypto precompile failed")
