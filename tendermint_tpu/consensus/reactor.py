@@ -120,8 +120,11 @@ class PeerState:
         with self._lock:
             if self.prs.height != msg.height:
                 return
-            if self.prs.proposal_block_parts is not None and \
-                    len(msg.parts_bits) == len(self.prs.proposal_block_parts):
+            if (self.prs.proposal_block_parts is None or
+                    len(msg.parts_bits) == len(self.prs.proposal_block_parts)):
+                # the peer's own bitmap is ground truth; also (re)creates
+                # the model after a round-change reset so catchup data
+                # gossip resumes from what the peer actually holds
                 self.prs.proposal_block_parts = list(msg.parts_bits)
 
     def set_has_proposal(self, proposal) -> None:
@@ -278,7 +281,8 @@ class ConsensusReactor(Reactor):
 
     # -- core -> network -----------------------------------------------
     def _on_core_broadcast(self, msg) -> None:
-        if isinstance(msg, (M.NewRoundStepMessage, M.HasVoteMessage)):
+        if isinstance(msg, (M.NewRoundStepMessage, M.HasVoteMessage,
+                            M.CommitStepMessage)):
             if self.switch is not None:
                 self.switch.broadcast(STATE_CHANNEL, M.encode_msg(msg))
         # proposals/parts/votes flow through the per-peer gossip routines
@@ -455,10 +459,28 @@ class ConsensusReactor(Reactor):
                 log.exception("gossip votes failed", peer=peer.id[:8])
                 time.sleep(self.gossip_sleep)
 
-    def _send_vote_from(self, peer: Peer, ps: PeerState, vs,
-                        theirs: list[bool] | None) -> bool:
+    def _send_vote_from(self, peer: Peer, ps: PeerState, vs) -> bool:
+        """Send one vote from vs the peer is missing.
+
+        The peer's bit-array is keyed by the VOTE SET's own
+        (height, round, type) — the reference's PickSendVote via
+        getVoteBitArray.  Keying by any other round (e.g. the peer's
+        advertised previous-height last_commit_round) wedges catchup: a
+        vote the model calls missing but the peer already has gets
+        re-sent forever while the votes it actually lacks never go out.
+        """
         if vs is None:
             return False
+        with ps._lock:
+            theirs = ps._bits_for(vs.height, vs.round, vs.type, vs.size())
+            if theirs is None:
+                # no trackable slot for this (height, round) on the peer
+                # (e.g. NEW_HEIGHT peer whose commit round differs from
+                # ours): sending would be an untracked resend hot-loop —
+                # the reference's PickSendVote also bails on a nil
+                # bit-array; other catchup branches cover the peer
+                return False
+            theirs = list(theirs)
         idx = ps.pick_missing(vs.bit_array(), theirs)
         if idx is None:
             return False
@@ -474,46 +496,32 @@ class ConsensusReactor(Reactor):
     def _gossip_votes_once(self, peer: Peer, ps: PeerState) -> bool:
         rs = self.cs.get_round_state()
         prs = ps.prs
-        n = rs.validators.size() if rs.validators else 0
         if rs.height == prs.height and rs.votes is not None:
             # peer waiting for the last commit at NewHeight
             if prs.step == STEP_NEW_HEIGHT and rs.last_commit is not None:
-                theirs = ps._bits_for(rs.height - 1, prs.last_commit_round,
-                                      TYPE_PRECOMMIT, n)
-                if self._send_vote_from(peer, ps, rs.last_commit, theirs):
+                if self._send_vote_from(peer, ps, rs.last_commit):
                     return True
             if prs.round >= 0 and prs.round <= rs.round:
                 pv = rs.votes.prevotes(prs.round)
-                if prs.step <= STEP_PREVOTE and self._send_vote_from(
-                        peer, ps, pv,
-                        ps._bits_for(rs.height, prs.round, TYPE_PREVOTE, n)):
+                if prs.step <= STEP_PREVOTE and \
+                        self._send_vote_from(peer, ps, pv):
                     return True
                 pc = rs.votes.precommits(prs.round)
-                if prs.step <= STEP_PRECOMMIT_WAIT and self._send_vote_from(
-                        peer, ps, pc,
-                        ps._bits_for(rs.height, prs.round, TYPE_PRECOMMIT,
-                                     n)):
+                if prs.step <= STEP_PRECOMMIT_WAIT and \
+                        self._send_vote_from(peer, ps, pc):
                     return True
                 # commit-step peers still need precommits of their round
-                if self._send_vote_from(
-                        peer, ps, pc,
-                        ps._bits_for(rs.height, prs.round, TYPE_PRECOMMIT,
-                                     n)):
+                if self._send_vote_from(peer, ps, pc):
                     return True
             if prs.proposal_pol_round >= 0:
                 pol = rs.votes.prevotes(prs.proposal_pol_round)
-                if self._send_vote_from(
-                        peer, ps, pol,
-                        ps._bits_for(rs.height, prs.proposal_pol_round,
-                                     TYPE_PREVOTE, n)):
+                if self._send_vote_from(peer, ps, pol):
                     return True
             return False
         # peer one height behind: our last_commit completes their commit
         if prs.height != 0 and rs.height == prs.height + 1 and \
                 rs.last_commit is not None:
-            theirs = ps._bits_for(prs.height, prs.last_commit_round,
-                                  TYPE_PRECOMMIT, rs.last_commit.size())
-            if self._send_vote_from(peer, ps, rs.last_commit, theirs):
+            if self._send_vote_from(peer, ps, rs.last_commit):
                 return True
         # peer far behind: seen-commit precommits from the store
         if prs.height != 0 and prs.height < rs.height and \

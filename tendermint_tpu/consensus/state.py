@@ -95,6 +95,7 @@ class ConsensusState:
 
         self.wal = WAL(wal_path, light=cfg.wal_light) if wal_path else None
         self._replay_mode = False
+        self._commit_step_bcast = 0.0   # last CommitStep broadcast
 
         # --- RoundState (reference :89-106) ---
         self.height = 0
@@ -360,6 +361,21 @@ class ConsensusState:
             height=rs.height, round=rs.round, step=rs.step,
             seconds_since_start=rs.seconds_since_start,
             last_commit_round=rs.last_commit_round))
+        if step == STEP_COMMIT:
+            self._broadcast_commit_step()
+
+    def _broadcast_commit_step(self) -> None:
+        """Advertise the REAL parts bitmap while waiting in commit
+        (reference sendNewRoundStepMessages also sends CommitStep):
+        without it, a catchup sender that believes it already delivered
+        every part (its model drifts on a drop or a round-change reset)
+        never re-sends, and a node stuck in Commit waits forever."""
+        if self.proposal_block_parts is None:
+            return
+        self._broadcast(M.CommitStepMessage(
+            height=self.height,
+            parts_total=self.proposal_block_parts.total,
+            parts_bits=tuple(self.proposal_block_parts.bit_array())))
 
     def _round_step_event(self) -> RoundStepEvent:
         lcr = self.last_commit.round if self.last_commit else -1
@@ -680,6 +696,15 @@ class ConsensusState:
                 self._enter_prevote(height, self.round)
             elif self.step == STEP_COMMIT:
                 self._try_finalize_commit(height)
+        elif self.step == STEP_COMMIT:
+            # still waiting in commit: keep peers' models of our parts
+            # honest so catchup senders re-send what actually went
+            # missing (time-throttled: a 300-part block must not emit
+            # 300 full-bitmap broadcasts)
+            now = time.time()
+            if now - self._commit_step_bcast >= 0.2:
+                self._commit_step_bcast = now
+                self._broadcast_commit_step()
 
     def _try_add_vote(self, vote: Vote, peer_id: str) -> None:
         """Reference `tryAddVote`/`addVote` `:1430-1565`."""

@@ -1,0 +1,86 @@
+"""secp256k1 key type — the reference crypto suite's alternative scheme.
+
+The reference's go-crypto dependency ships `PrivKeySecp256k1` next to
+ed25519 (SURVEY §2.4; reference glide.yaml go-crypto ~0.2.2); consensus
+never uses it for votes — it exists for account/client identities.  The
+same holds here: validator signing stays ed25519 (the batched device
+plane), while this module provides the alternative type with the same
+surface (sign/verify/address) over the OpenSSL-backed `cryptography`
+primitives.  Signatures are DER-encoded ECDSA-SHA256; public keys are
+33-byte compressed SEC1 points; addresses hash the compressed key like
+`keys.address_from_pubkey`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+try:
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    AVAILABLE = True
+except ImportError:                      # pragma: no cover - env dependent
+    AVAILABLE = False
+
+from tendermint_tpu.types.keys import address_from_pubkey
+
+PUBKEY_LEN = 33     # compressed SEC1
+
+
+@dataclass(frozen=True)
+class PubKeySecp256k1:
+    bytes_: bytes    # compressed SEC1 point
+
+    def __post_init__(self):
+        if len(self.bytes_) != PUBKEY_LEN:
+            raise ValueError("secp256k1 pubkey must be 33 bytes (SEC1)")
+
+    @property
+    def address(self) -> bytes:
+        return address_from_pubkey(self.bytes_)
+
+    def verify(self, msg: bytes, sig: bytes) -> bool:
+        if not AVAILABLE:
+            raise RuntimeError("cryptography package unavailable")
+        try:
+            pub = ec.EllipticCurvePublicKey.from_encoded_point(
+                ec.SECP256K1(), self.bytes_)
+            pub.verify(sig, msg, ec.ECDSA(hashes.SHA256()))
+            return True
+        except (InvalidSignature, ValueError):
+            return False
+
+    def hex(self) -> str:
+        return self.bytes_.hex()
+
+
+class PrivKeySecp256k1:
+    def __init__(self, secret: bytes):
+        if not AVAILABLE:
+            raise RuntimeError("cryptography package unavailable")
+        if len(secret) != 32:
+            raise ValueError("secret must be 32 bytes")
+        self._key = ec.derive_private_key(
+            int.from_bytes(secret, "big"), ec.SECP256K1())
+        self.secret = secret
+
+    @classmethod
+    def generate(cls) -> "PrivKeySecp256k1":
+        import secrets as _s
+        while True:
+            cand = _s.token_bytes(32)
+            try:
+                return cls(cand)
+            except ValueError:           # pragma: no cover - 2^-128 branch
+                continue
+
+    @property
+    def pub_key(self) -> PubKeySecp256k1:
+        pub = self._key.public_key().public_bytes(
+            serialization.Encoding.X962,
+            serialization.PublicFormat.CompressedPoint)
+        return PubKeySecp256k1(pub)
+
+    def sign(self, msg: bytes) -> bytes:
+        return self._key.sign(msg, ec.ECDSA(hashes.SHA256()))

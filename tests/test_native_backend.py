@@ -124,3 +124,22 @@ def test_native_speed_is_native():
         assert native.verify_one(pub, msg, sig)
     rate = n / (time.perf_counter() - t0)
     assert rate > 2000, f"native verify too slow: {rate:.0f}/s"
+
+
+def test_secp256k1_alt_key_type():
+    """go-crypto parity: the alternative secp256k1 scheme (SURVEY §2.4);
+    validator voting stays ed25519."""
+    from tendermint_tpu.crypto import secp256k1 as s
+    if not s.AVAILABLE:
+        pytest.skip("cryptography unavailable")
+    priv = s.PrivKeySecp256k1(b"\x07" * 32)
+    pub = priv.pub_key
+    assert len(pub.bytes_) == 33 and len(pub.address) == 20
+    sig = priv.sign(b"alt-key msg")
+    assert pub.verify(b"alt-key msg", sig)
+    assert not pub.verify(b"alt-key msG", sig)
+    assert not pub.verify(b"alt-key msg", sig[:-1] + b"\x00")
+    # deterministic derivation: same secret -> same key
+    assert s.PrivKeySecp256k1(b"\x07" * 32).pub_key == pub
+    other = s.PrivKeySecp256k1.generate()
+    assert not other.pub_key.verify(b"alt-key msg", sig)
