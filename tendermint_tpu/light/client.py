@@ -36,10 +36,13 @@ log = get_logger("light")
 @dataclass(frozen=True)
 class TrustedState:
     """What a light client believes: a header it has verified and the
-    validator set that header commits to for its NEXT height."""
+    validator set AUTHENTICATED at that height (it hashes to the verified
+    header's `validators_hash`).  A later header signed by a different set
+    is accepted only via the two-set rule (`verify_commit_any`), so the
+    trust root is never seeded from unauthenticated input."""
     height: int
     header_hash: bytes
-    next_validators: ValidatorSet
+    validators: ValidatorSet
 
 
 @dataclass(frozen=True)
@@ -100,16 +103,16 @@ class LightClient:
         self.chain_id = chain_id
         self.trusted = trusted
 
-    def update(self, sh: SignedHeader, validators: ValidatorSet,
-               next_validators: ValidatorSet) -> TrustedState:
+    def update(self, sh: SignedHeader,
+               validators: ValidatorSet) -> TrustedState:
         """Verify sh against the trusted state and advance to it.
 
         validators must hash to sh.header.validators_hash (its height's
         set); a valset change relative to the trusted set is accepted only
         via the two-set rule (`verify_commit_any`), so a fabricated set
-        can never take over without +2/3 of the OLD set co-signing.
-        next_validators seeds the next step (authenticated the same way
-        when IT is consumed — era headers do not commit the next set).
+        can never take over without +2/3 of the OLD set co-signing.  The
+        new trusted state stores this same authenticated set — nothing
+        unauthenticated ever becomes the trust root.
         """
         sh.validate_basic()
         h = sh.header
@@ -132,14 +135,14 @@ class LightClient:
         block_id = sh.commit.block_id
         if block_id.hash != h.hash():
             raise ValueError("commit is not for this header")
-        trusted_set = self.trusted.next_validators
+        trusted_set = self.trusted.validators
         if trusted_set.hash() == validators.hash():
             validators.verify_commit(self.chain_id, block_id, h.height,
                                      sh.commit)
         else:
             verify_commit_any(trusted_set, validators, self.chain_id,
                               block_id, h.height, sh.commit)
-        self.trusted = TrustedState(h.height, h.hash(), next_validators)
+        self.trusted = TrustedState(h.height, h.hash(), validators)
         return self.trusted
 
 

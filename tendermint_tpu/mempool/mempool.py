@@ -84,12 +84,17 @@ class Mempool:
         self._txs_available_cb = cb
 
     # -- WAL recovery (SURVEY §5 checkpoint layer 5) ----------------------
-    def recover_wal(self) -> int:
+    def recover_wal(self, committed=None) -> int:
         """Re-admit journalled txs after a crash (call once at boot, after
         the app handshake restored app state).  Entries are re-run through
-        CheckTx — txs already committed meanwhile are rejected by the app
-        or deduped by the block — and a torn tail is truncated.  Returns
-        the number of txs re-admitted."""
+        CheckTx; `committed` (tx_bytes -> bool), when given, drops journal
+        entries already committed to a block (e.g. via the tx index) so a
+        crash between block commit and journal compaction does not re-admit
+        them — apps whose CheckTx accepts anything (kvstore) would
+        otherwise see at-least-once redelivery.  Without `committed` the
+        contract IS at-least-once: the app's CheckTx must reject replays
+        of committed txs.  A torn tail is truncated.  Returns the number
+        of txs re-admitted."""
         if not self._wal_path:
             return 0
         try:
@@ -108,6 +113,13 @@ class Mempool:
         self._recovering = True
         try:
             for tx in txs:
+                if committed is not None and committed(tx):
+                    with self._lock:
+                        # permanently dedupe, like update(): a peer
+                        # gossiping or a client rebroadcasting this tx
+                        # after the restart must not re-admit it either
+                        self._cache[Tx(tx).hash] = None
+                    continue
                 res = self.check_tx(tx)
                 if res is not None and res.is_ok:
                     readmitted += 1

@@ -79,13 +79,18 @@ class Node:
                        if base.db_backend != "memdb" else "")
         self.mempool = Mempool(self.proxy_app.mempool, config.mempool,
                                wal_path=mempool_wal)
-        if mempool_wal:
-            n = self.mempool.recover_wal()
-            if n:
-                log.info("mempool wal recovered", txs=n)
         self.tx_indexer = (KVTxIndexer(mk("tx_index"))
                            if base.db_backend != "memdb"
                            else KVTxIndexer(new_db("memdb")))
+        if mempool_wal:
+            # the tx index says which journalled txs already committed —
+            # don't re-admit those (kvstore-style apps accept replays)
+            from tendermint_tpu.types.tx import Tx
+            n = self.mempool.recover_wal(
+                committed=lambda tx: self.tx_indexer.get(Tx(tx).hash)
+                is not None)
+            if n:
+                log.info("mempool wal recovered", txs=n)
         wal_path = (os.path.join(base.db_dir(), "cs.wal")
                     if base.db_backend != "memdb" else "")
         self.consensus = ConsensusState(
@@ -101,7 +106,7 @@ class Node:
         self.evsw.subscribe(
             "node-evidence", "EvidenceDoubleSign",
             lambda ev: self.evidence_pool.add(
-                ev, self.consensus.state.validators))
+                ev, self._valset_at(ev.vote_a.height)))
 
         # --- p2p switch (built when a listen addr is configured) ---
         self.switch = None
@@ -118,6 +123,14 @@ class Node:
         self.rpc_server = None
         self.grpc_server = None
         self._stopped = threading.Event()
+
+    def _valset_at(self, height: int):
+        """The validator set that signed votes at `height`: from saved
+        history when available (evidence can arrive after an EndBlock
+        membership change), else the live set."""
+        st = self.consensus.state
+        vs = st.load_validators(height)
+        return vs if vs is not None else st.validators
 
     @property
     def state(self):

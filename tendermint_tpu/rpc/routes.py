@@ -80,16 +80,17 @@ class Routes:
             "tx": self.tx,
             "net_info": self.net_info,
             "evidence": self.evidence,
-            "debug_stacks": self.debug_stacks,
-            "debug_trace_start": self.debug_trace_start,
-            "debug_trace_stop": self.debug_trace_stop,
         }
         if getattr(node.config.rpc, "unsafe", False):
             # operator-only routes, served only with rpc.unsafe = true
-            # (reference rpc/core/routes.go:30-36 AddUnsafeRoutes)
+            # (reference rpc/core/routes.go:30-46 AddUnsafeRoutes — the
+            # profiler/debug API is unsafe-gated there too)
             self.table.update({
                 "unsafe_flush_mempool": self.unsafe_flush_mempool,
                 "unsafe_dial_seeds": self.unsafe_dial_seeds,
+                "debug_stacks": self.debug_stacks,
+                "debug_trace_start": self.debug_trace_start,
+                "debug_trace_stop": self.debug_trace_stop,
             })
 
     # -- info routes ----------------------------------------------------
@@ -220,9 +221,13 @@ class Routes:
         # the name is an RPC param: allow only a flat subdirectory under
         # the fixed trace base (no path escape / arbitrary-dir writes)
         name = str(params.get("name") or "trace")
-        if not re.fullmatch(r"[A-Za-z0-9._-]{1,64}", name):
+        if (not re.fullmatch(r"[A-Za-z0-9._-]{1,64}", name)
+                or set(name) == {"."}):
             raise ValueError("trace name must match [A-Za-z0-9._-]{1,64}")
-        d = os.path.join("/tmp/tendermint_tpu_trace", name)
+        base = os.path.realpath("/tmp/tendermint_tpu_trace")
+        d = os.path.realpath(os.path.join(base, name))
+        if os.path.dirname(d) != base:
+            raise ValueError("trace name escapes the trace directory")
         return {"started": trace.start_device_trace(d), "dir": d}
 
     def debug_trace_stop(self, params: dict) -> dict:

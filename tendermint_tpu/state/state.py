@@ -22,6 +22,10 @@ def _abci_responses_key(height: int) -> bytes:
     return b"abciResponsesKey:%d" % height
 
 
+def _validators_key(height: int) -> bytes:
+    return b"validatorsKey:%d" % height
+
+
 @dataclass
 class ABCIResponses:
     """Results of executing one block, persisted *before* the app commits
@@ -84,6 +88,18 @@ class State:
     def save(self) -> None:
         assert self.db is not None
         self.db.set(_STATE_KEY, self.encode())
+        # validator-set history: the set that signs votes AT height
+        # last_block_height+1 (for evidence/light verification against
+        # the right era's keys; modern tendermint's LoadValidators)
+        self.db.set(_validators_key(self.last_block_height + 1),
+                    self.validators.encode())
+
+    def load_validators(self, height: int) -> ValidatorSet | None:
+        """The set that signed votes at `height`, from saved history."""
+        if self.db is None:
+            return None
+        raw = self.db.get(_validators_key(height))
+        return ValidatorSet.decode(Reader(raw)) if raw else None
 
     def save_abci_responses(self, resp: ABCIResponses) -> None:
         assert self.db is not None

@@ -35,7 +35,7 @@ def test_sequential_follow():
     privs, vs, chain = _chain(4)
     lc = LightClient("light-chain", TrustedState(0, b"", vs))
     for block, ps, seen in chain:
-        st = lc.update(SignedHeader(block.header, seen), vs, vs)
+        st = lc.update(SignedHeader(block.header, seen), vs)
         assert st.height == block.height
         assert st.header_hash == block.hash()
 
@@ -46,11 +46,11 @@ def test_rejects_wrong_valset_and_gaps():
     lc = LightClient("light-chain", TrustedState(0, b"", vs))
     block, ps, seen = chain[0]
     with pytest.raises(ValueError, match="validators_hash"):
-        lc.update(SignedHeader(block.header, seen), other_vs, other_vs)
+        lc.update(SignedHeader(block.header, seen), other_vs)
     # height gap
     b2 = chain[2][0]
     with pytest.raises(ValueError, match="non-sequential"):
-        lc.update(SignedHeader(b2.header, chain[2][2]), vs, vs)
+        lc.update(SignedHeader(b2.header, chain[2][2]), vs)
 
 
 def test_rejects_tampered_commit():
@@ -63,7 +63,7 @@ def test_rejects_tampered_commit():
     bad = Commit(block_id=BlockID(b"\x55" * 32, ps.header),
                  precommits=seen.precommits)
     with pytest.raises(ValueError, match="not for this header"):
-        lc.update(SignedHeader(block.header, bad), vs, vs)
+        lc.update(SignedHeader(block.header, bad), vs)
 
 
 def test_verify_commit_any_two_sets():
@@ -90,7 +90,7 @@ def test_update_through_valset_change():
     chain = build_chain(privs, vs, chain_id, 1, txs_per_block=1)
     lc = LightClient(chain_id, TrustedState(0, b"", vs))
     b1, ps1, seen1 = chain[0]
-    lc.update(SignedHeader(b1.header, seen1), vs, vs)
+    lc.update(SignedHeader(b1.header, seen1), vs)
     # height 2 signed by a GROWN set (old 4 + 2 new members); +2/3 of the
     # old set are present among the signers
     extra_privs, _ = make_validators(2, seed=5)
@@ -106,9 +106,9 @@ def test_update_through_valset_change():
     ps2 = b2.make_part_set()
     seen2 = make_commit(all_privs, new_vs, chain_id, 2,
                         BlockID(b2.hash(), ps2.header))
-    st = lc.update(SignedHeader(b2.header, seen2), new_vs, new_vs)
+    st = lc.update(SignedHeader(b2.header, seen2), new_vs)
     assert st.height == 2
-    assert lc.trusted.next_validators is new_vs
+    assert lc.trusted.validators is new_vs
 
 
 def test_verify_chains_batched_multi_chain():

@@ -117,3 +117,25 @@ def test_wal_compacts_committed_txs(tmp_path):
     mp2 = Mempool(conns2.mempool, wal_path=wal)
     assert mp2.recover_wal() == 2
     assert mp2.reap(-1) == [b"c2=v", b"c3=v"]
+
+
+def test_recover_wal_committed_filter(tmp_path):
+    """A crash between block commit and journal compaction must not
+    re-admit committed txs (ADVICE r3): the `committed` predicate drops
+    them AND permanently dedupes, so a later gossip/rebroadcast of the
+    same tx is refused too."""
+    wal = str(tmp_path / "mempool.wal")
+    conns = ClientCreator("kvstore").new_app_conns()
+    mp = Mempool(conns.mempool, wal_path=wal)
+    for i in range(4):
+        assert mp.check_tx(b"f%d=v" % i).is_ok
+    # crash BEFORE update() compacts: journal still holds all 4
+    conns2 = ClientCreator("kvstore").new_app_conns()
+    mp2 = Mempool(conns2.mempool, wal_path=wal)
+    committed = {b"f0=v", b"f2=v"}
+    assert mp2.recover_wal(committed=lambda tx: tx in committed) == 2
+    assert mp2.reap(-1) == [b"f1=v", b"f3=v"]
+    # gossip/client rebroadcast of a committed tx is cache-refused
+    assert mp2.check_tx(b"f0=v") is None
+    # a genuinely new tx is still admitted
+    assert mp2.check_tx(b"f9=v").is_ok

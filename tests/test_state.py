@@ -207,3 +207,28 @@ def test_exec_commit_block_replay():
     h1 = execution.exec_commit_block(conns.consensus, chain[0][0])
     h2 = execution.exec_commit_block(conns.consensus, chain[1][0])
     assert h1 != h2 and st.last_block_height == 0
+
+
+def test_validator_history_by_height():
+    """save() journals the set signing at height+1; evidence/light
+    verification resolves the right era's keys after membership changes
+    (modern tendermint LoadValidators)."""
+    privs, vs, st, conns = _setup(app="nilapp")
+    st.save()
+    assert st.load_validators(1).hash() == vs.hash()
+    chain = build_chain(privs, vs, CHAIN, 2)
+    for block, ps, _ in chain:
+        execution.apply_block(st, None, conns.consensus, block, ps.header,
+                              execution.MockMempool())
+    # membership change: double val0's power for the NEXT height
+    old_hash = st.validators.hash()
+    st.set_block_and_validators(
+        chain[-1][0].header, BlockID(chain[-1][0].hash(), chain[-1][1].header),
+        [(st.validators.validators[0].pub_key.bytes_, 20)])
+    st.save()
+    assert st.load_validators(st.last_block_height + 1).hash() == \
+        st.validators.hash()
+    assert st.load_validators(st.last_block_height + 1).hash() != old_hash
+    # earlier heights still resolve the era sets
+    assert st.load_validators(1).hash() == vs.hash()
+    assert st.load_validators(999) is None
