@@ -23,6 +23,10 @@ MAX_PENDING = 300                # reference maxPendingRequests
 MAX_PENDING_PER_PEER = 75        # reference maxPendingRequestsPerPeer
 REQUEST_TIMEOUT = 3.0            # redo a request after this long
 MAX_PEER_TIMEOUTS = 4            # evict after this many consecutive redos
+MIN_RECV_RATE = 10_240           # reference minRecvRate (10 KB/s),
+                                 # blockchain/pool.go:14-19
+STARVE_AGE = 1.0                 # a request outstanding this long marks
+                                 # the peer as starving the sync window
 
 
 class _Slot:
@@ -36,27 +40,40 @@ class _Slot:
 
 
 class BlockPool:
-    def __init__(self, start_height: int):
+    def __init__(self, start_height: int,
+                 min_recv_rate: int = MIN_RECV_RATE):
         self.next_height = start_height       # first height not yet popped
+        self.min_recv_rate = min_recv_rate
         self._slots: dict[int, _Slot] = {}
         self._peers: dict[str, int] = {}      # peer_id -> reported height
         self._peer_pending: dict[str, int] = {}
         self._peer_timeouts: dict[str, int] = {}
+        self._peer_meters: dict[str, object] = {}   # peer_id -> Meter
         self._lock = threading.Lock()
         self.on_evict = None                  # cb(peer_id, reason)
 
     # -- peers ----------------------------------------------------------
     def set_peer_height(self, peer_id: str, height: int) -> None:
+        from tendermint_tpu.utils.flowrate import Meter
         with self._lock:
             self._peers[peer_id] = height
             self._peer_pending.setdefault(peer_id, 0)
             self._peer_timeouts.setdefault(peer_id, 0)
+            self._peer_meters.setdefault(peer_id, Meter())
+
+    def record_bytes(self, peer_id: str, nbytes: int) -> None:
+        """Feed the peer's receive meter (called per delivered block)."""
+        with self._lock:
+            m = self._peer_meters.get(peer_id)
+        if m is not None:
+            m.update(nbytes)
 
     def remove_peer(self, peer_id: str) -> None:
         with self._lock:
             self._peers.pop(peer_id, None)
             self._peer_pending.pop(peer_id, None)
             self._peer_timeouts.pop(peer_id, None)
+            self._peer_meters.pop(peer_id, None)
             for slot in list(self._slots.values()):
                 if slot.peer_id == peer_id and slot.block is None:
                     del self._slots[slot.height]
@@ -78,6 +95,26 @@ class BlockPool:
         now = time.monotonic()
         evictions: set[str] = set()
         with self._lock:
+            # rate-based eviction (reference removeTimedoutPeers,
+            # blockchain/pool.go:100-118): a peer that keeps a request
+            # outstanding past STARVE_AGE while its delivery rate is
+            # under min_recv_rate throttles the whole window — evict it
+            # even though it answers just inside the redo timeout (the
+            # slow-drip case the redo counter never catches)
+            starving: set[str] = set()
+            for slot in self._slots.values():
+                if slot.block is None and now - slot.sent_at >= STARVE_AGE:
+                    starving.add(slot.peer_id)
+            for pid in starving:
+                m = self._peer_meters.get(pid)
+                # total > 0: never judge a peer that has not delivered
+                # its FIRST block yet (the reference's curRate == 0
+                # exclusion — "curRate can be 0 on start"); the redo
+                # timeout handles truly dead peers
+                if m is not None and m.total > 0 and \
+                        m.age(now) >= STARVE_AGE and \
+                        m.rate(now) < self.min_recv_rate:
+                    evictions.add(pid)
             # redo timed-out requests on a different peer
             for slot in self._slots.values():
                 if slot.block is not None or \
@@ -208,4 +245,6 @@ class BlockPool:
             return {"next_height": self.next_height,
                     "in_flight": len(self._slots) - ready,
                     "ready": ready, "peers": len(self._peers),
-                    "max_peer_height": self.max_peer_height_locked()}
+                    "max_peer_height": self.max_peer_height_locked(),
+                    "peer_rates": {p[:12]: round(m.rate(), 1)
+                                   for p, m in self._peer_meters.items()}}

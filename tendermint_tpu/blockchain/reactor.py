@@ -148,7 +148,10 @@ class BlockchainReactor(Reactor):
             except (ValueError, IndexError) as e:
                 self.switch.stop_peer_for_error(peer, f"bad block: {e}")
                 return
-            self.pool.add_block(peer.id, block)
+            if self.pool.add_block(peer.id, block):
+                # feed the peer's flowrate meter — the slow-drip
+                # eviction (reference minRecvRate) keys off this
+                self.pool.record_bytes(peer.id, len(raw))
         elif isinstance(msg, BM.StatusRequest):
             peer.try_send(BLOCKCHAIN_CHANNEL, BM.encode_msg(
                 BM.StatusResponse(self.store.height)))

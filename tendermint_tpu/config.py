@@ -112,12 +112,16 @@ def test_config() -> Config:
     c.base.db_backend = "memdb"
     c.base.crypto_backend = "python"
     c.base.fast_sync = False
+    # deltas keep the reference's growth ratio (~1/6 of base per round,
+    # config/config.go:365-371): failed rounds must lengthen enough that
+    # a loaded scheduler self-heals instead of churning rounds for
+    # minutes (the r3 stress-tier finding)
     c.consensus.timeout_propose = 0.1
-    c.consensus.timeout_propose_delta = 0.002
+    c.consensus.timeout_propose_delta = 0.02
     c.consensus.timeout_prevote = 0.02
-    c.consensus.timeout_prevote_delta = 0.002
+    c.consensus.timeout_prevote_delta = 0.01
     c.consensus.timeout_precommit = 0.02
-    c.consensus.timeout_precommit_delta = 0.002
+    c.consensus.timeout_precommit_delta = 0.01
     c.consensus.timeout_commit = 0.02
     c.consensus.skip_timeout_commit = True
     return c

@@ -23,6 +23,7 @@ TAG_HAS_VOTE = 0x13
 TAG_VOTE_SET_MAJ23 = 0x14
 TAG_VOTE_SET_BITS = 0x15
 TAG_PROPOSAL_POL = 0x16
+TAG_PROPOSAL_HEARTBEAT = 0x17
 
 
 @dataclass(frozen=True)
@@ -90,6 +91,13 @@ class ProposalPOLMessage:
     proposal_pol: tuple
 
 
+@dataclass(frozen=True)
+class ProposalHeartbeatMessage:
+    """Proposer liveness signal while waiting for txs
+    (reference `consensus/reactor.go:214`, `consensus/state.go:820-847`)."""
+    heartbeat: object          # types.proposal.Heartbeat
+
+
 def _bits_encode(bits) -> bytes:
     out = u32(len(bits))
     by = bytearray((len(bits) + 7) // 8)
@@ -134,6 +142,8 @@ def encode_msg(msg) -> bytes:
         return (u8(TAG_PROPOSAL_POL) + u64(msg.height) +
                 u32(msg.proposal_pol_round + 1) +
                 _bits_encode(msg.proposal_pol))
+    if isinstance(msg, ProposalHeartbeatMessage):
+        return u8(TAG_PROPOSAL_HEARTBEAT) + msg.heartbeat.encode()
     raise TypeError(f"cannot encode {type(msg).__name__}")
 
 
@@ -169,4 +179,7 @@ def decode_msg(data: bytes):
         return ProposalPOLMessage(height=r.u64(),
                                   proposal_pol_round=r.u32() - 1,
                                   proposal_pol=_bits_decode(r))
+    if tag == TAG_PROPOSAL_HEARTBEAT:
+        from tendermint_tpu.types.proposal import Heartbeat
+        return ProposalHeartbeatMessage(Heartbeat.decode(r))
     raise ValueError(f"unknown consensus message tag {tag:#x}")

@@ -32,7 +32,14 @@ DATA_CHANNEL = 0x21
 VOTE_CHANNEL = 0x22
 VOTE_SET_BITS_CHANNEL = 0x23
 
-GOSSIP_SLEEP = 0.02          # reference peerGossipSleepDuration (100ms)
+GOSSIP_SLEEP = 0.1           # IDLE-ONLY safety net; gossip is event-driven
+                             # (reference peerGossipSleepDuration 100ms, but
+                             # the reference POLLS at that cadence — here a
+                             # condition variable wakes the routines the
+                             # moment core or peer state changes, so the
+                             # sleep only bounds staleness after a missed
+                             # signal; VERDICT r3: 20ms polling across
+                             # N peers x 3 threads starved the GIL)
 MAJ23_SLEEP = 0.5            # reference peerQueryMaj23SleepDuration (2s)
 
 
@@ -245,9 +252,25 @@ class ConsensusReactor(Reactor):
         self.gossip_sleep = gossip_sleep
         self._peer_stops: dict[str, threading.Event] = {}
         self._lock = threading.Lock()
+        # event-driven gossip: every core broadcast and every applied peer
+        # message bumps the sequence and wakes all gossip routines; idle
+        # routines block here instead of busy-polling
+        self._wake = threading.Condition()
+        self._wake_seq = 0
         # core -> network: NewRoundStep/HasVote broadcasts
         # (reference `registerEventCallbacks` :321-382)
         self.cs.broadcast_cb = self._on_core_broadcast
+
+    def _notify_work(self) -> None:
+        with self._wake:
+            self._wake_seq += 1
+            self._wake.notify_all()
+
+    def _wait_work(self, seen_seq: int, timeout: float) -> None:
+        """Block until the work sequence moves past seen_seq or timeout."""
+        with self._wake:
+            if self._wake_seq == seen_seq:
+                self._wake.wait(timeout)
 
     def get_channels(self):
         return [
@@ -269,6 +292,7 @@ class ConsensusReactor(Reactor):
         with self._lock:
             for ev in self._peer_stops.values():
                 ev.set()
+        self._notify_work()
         self.cs.stop()
 
     def switch_to_consensus(self, state) -> None:
@@ -282,10 +306,13 @@ class ConsensusReactor(Reactor):
     # -- core -> network -----------------------------------------------
     def _on_core_broadcast(self, msg) -> None:
         if isinstance(msg, (M.NewRoundStepMessage, M.HasVoteMessage,
-                            M.CommitStepMessage)):
+                            M.CommitStepMessage,
+                            M.ProposalHeartbeatMessage)):
             if self.switch is not None:
                 self.switch.broadcast(STATE_CHANNEL, M.encode_msg(msg))
-        # proposals/parts/votes flow through the per-peer gossip routines
+        # proposals/parts/votes flow through the per-peer gossip routines —
+        # wake them: the core's state just changed
+        self._notify_work()
 
     # -- peer lifecycle -------------------------------------------------
     def add_peer(self, peer: Peer) -> None:
@@ -312,6 +339,7 @@ class ConsensusReactor(Reactor):
             stop = self._peer_stops.pop(peer.id, None)
         if stop is not None:
             stop.set()
+        self._notify_work()   # unblock its waiting gossip routines
 
     # -- inbound demux (reference :159-302) ------------------------------
     def receive(self, ch_id: int, peer: Peer, raw: bytes) -> None:
@@ -323,6 +351,14 @@ class ConsensusReactor(Reactor):
         ps: PeerState = peer.get("consensus")
         if ps is None:
             return
+        try:
+            self._receive(ch_id, peer, ps, msg)
+        finally:
+            # applied peer state (or fed the core): gossip routines may
+            # now have sendable work for this peer — wake them
+            self._notify_work()
+
+    def _receive(self, ch_id: int, peer: Peer, ps: "PeerState", msg) -> None:
         if ch_id == STATE_CHANNEL:
             if isinstance(msg, M.NewRoundStepMessage):
                 ps.apply_new_round_step(msg)
@@ -332,6 +368,12 @@ class ConsensusReactor(Reactor):
                 ps.set_has_vote(msg.height, msg.round, msg.type, msg.index)
             elif isinstance(msg, M.VoteSetMaj23Message):
                 self._on_vote_set_maj23(peer, ps, msg)
+            elif isinstance(msg, M.ProposalHeartbeatMessage):
+                hb = msg.heartbeat
+                # observability only (reference :214-218 logs it)
+                log.debug("proposal heartbeat", peer=peer.id[:8],
+                          height=hb.height, round=hb.round,
+                          seq=hb.sequence)
         elif ch_id == DATA_CHANNEL:
             if self.fast_sync:
                 return
@@ -384,14 +426,18 @@ class ConsensusReactor(Reactor):
     # -- gossip routines -------------------------------------------------
     def _gossip_data_routine(self, peer: Peer, ps: PeerState,
                              stop: threading.Event) -> None:
-        """Reference `gossipDataRoutine` :413-491."""
+        """Reference `gossipDataRoutine` :413-491 — event-driven: the
+        sequence is snapshotted BEFORE each scan, so any state change
+        that lands mid-scan retriggers immediately instead of being lost
+        to the wait."""
         while not stop.is_set():
             try:
+                seq = self._wake_seq
                 if not self._gossip_data_once(peer, ps):
-                    time.sleep(self.gossip_sleep)
+                    self._wait_work(seq, self.gossip_sleep)
             except Exception:
                 log.exception("gossip data failed", peer=peer.id[:8])
-                time.sleep(self.gossip_sleep)
+                stop.wait(self.gossip_sleep)
 
     def _gossip_data_once(self, peer: Peer, ps: PeerState) -> bool:
         rs = self.cs.get_round_state()
@@ -450,14 +496,16 @@ class ConsensusReactor(Reactor):
 
     def _gossip_votes_routine(self, peer: Peer, ps: PeerState,
                               stop: threading.Event) -> None:
-        """Reference `gossipVotesRoutine` :537-643."""
+        """Reference `gossipVotesRoutine` :537-643 — event-driven (see
+        `_gossip_data_routine`)."""
         while not stop.is_set():
             try:
+                seq = self._wake_seq
                 if not self._gossip_votes_once(peer, ps):
-                    time.sleep(self.gossip_sleep)
+                    self._wait_work(seq, self.gossip_sleep)
             except Exception:
                 log.exception("gossip votes failed", peer=peer.id[:8])
-                time.sleep(self.gossip_sleep)
+                stop.wait(self.gossip_sleep)
 
     def _send_vote_from(self, peer: Peer, ps: PeerState, vs) -> bool:
         """Send one vote from vs the peer is missing.
@@ -550,7 +598,8 @@ class ConsensusReactor(Reactor):
         """Advertise our two-thirds majorities so peers can prove theirs
         (reference `queryMaj23Routine` :647-753)."""
         while not stop.is_set():
-            time.sleep(MAJ23_SLEEP)
+            if stop.wait(MAJ23_SLEEP):
+                return
             try:
                 rs = self.cs.get_round_state()
                 prs = ps.prs

@@ -69,6 +69,15 @@ class RoundStepEvent:
     last_commit_round: int
 
 
+@dataclass(frozen=True)
+class _TxsAvailable:
+    """Internal queue marker: the mempool has txs for `height`."""
+    height: int
+
+
+PROPOSAL_HEARTBEAT_INTERVAL = 2.0   # reference consensus/state.go:28
+
+
 class ConsensusState:
     """Single-node consensus core.  The reactor (gossip) layer plugs in via
     `broadcast_cb` (outbound messages) and the public feed methods
@@ -96,6 +105,14 @@ class ConsensusState:
         self.wal = WAL(wal_path, light=cfg.wal_light) if wal_path else None
         self._replay_mode = False
         self._commit_step_bcast = 0.0   # last CommitStep broadcast
+        # wait-for-txs (create_empty_blocks = false): the mempool's
+        # height-gated txs-available notification unblocks enterPropose
+        # (reference consensus/state.go:793-801); delivered through the
+        # serialized queue like every other input
+        if (not cfg.create_empty_blocks and
+                hasattr(mempool, "set_txs_available_callback")):
+            mempool.set_txs_available_callback(
+                lambda h: self._queue.put(_TxsAvailable(h)))
 
         # --- RoundState (reference :89-106) ---
         self.height = 0
@@ -114,6 +131,7 @@ class ConsensusState:
         self.votes: HeightVoteSet | None = None
         self.commit_round = -1
         self.last_commit: VoteSet | None = None
+        self._app_hash_changed: bool | None = None   # set per height
 
         self._update_to_state(state)
         self._reconstruct_last_commit(state)
@@ -262,6 +280,8 @@ class ConsensusState:
                             self.wal.save_timeout(item.height, item.round,
                                                   item.step)
                         self._handle_timeout(item)
+                    elif isinstance(item, _TxsAvailable):
+                        self._handle_txs_available(item)
                     else:
                         msg, peer_id = item
                         if self.wal is not None and not self._replay_mode:
@@ -301,6 +321,9 @@ class ConsensusState:
             return
         if ti.step == STEP_NEW_HEIGHT:
             self._enter_new_round(ti.height, 0)
+        elif ti.step == STEP_NEW_ROUND:
+            # create_empty_blocks_interval expired while holding for txs
+            self._enter_propose(ti.height, 0)
         elif ti.step == STEP_PROPOSE:
             self.evsw.fire(ev.TIMEOUT_PROPOSE, self._round_step_event())
             self._enter_prevote(ti.height, ti.round)
@@ -328,6 +351,12 @@ class ConsensusState:
                 raise RuntimeError("expected +2/3 precommits for last commit")
             last_precommits = pc
 
+        old_state = self.state
+        self._app_hash_changed = (
+            old_state.app_hash != state.app_hash
+            if (old_state is not None and
+                old_state.last_block_height + 1 == state.last_block_height)
+            else None)
         height = state.last_block_height + 1
         self.height = height
         self.round = 0
@@ -412,7 +441,87 @@ class ConsensusState:
             self.proposal_block_parts = None
         self.votes.set_round(round_ + 1)
         self.evsw.fire(ev.NEW_ROUND, self._round_step_event())
+        # wait-for-txs (reference :793-803): with create_empty_blocks off,
+        # round 0 holds in NewRound until the mempool reports txs (unless
+        # the app hash changed — a "proof block" must commit it); the
+        # proposer signs heartbeats meanwhile so peers see it alive
+        if (not self.cfg.create_empty_blocks and round_ == 0 and
+                not self._need_proof_block(height)):
+            # consult the pool directly, not only the notification: a
+            # txs-available marker that fired during the commit (before
+            # this hold existed) was consumed at STEP_NEW_HEIGHT and the
+            # mempool's once-per-height latch will not re-fire
+            if getattr(self.mempool, "size", lambda: 0)() > 0:
+                self._enter_propose(height, round_)
+                return
+            # advertise the hold: without a NewRoundStep broadcast peers
+            # still model this node at (height-1, Commit) and would only
+            # gossip stale catchup material, never this height's
+            # proposal/parts/votes — a >=1/3-power validator parked that
+            # way would halt the chain
+            self._new_step(STEP_NEW_ROUND)
+            if self.cfg.create_empty_blocks_interval > 0:
+                self._ticker.schedule_timeout(TimeoutInfo(
+                    height, round_, STEP_NEW_ROUND,
+                    self.cfg.create_empty_blocks_interval))
+            self._start_heartbeat(height, round_)
+            return
         self._enter_propose(height, round_)
+
+    def _need_proof_block(self, height: int) -> bool:
+        """First height, or the last block changed the app hash
+        (reference `needProofBlock` :807-818).  The transition is tracked
+        in `_update_to_state` (one flag) — loading and decoding the full
+        previous block per round just to read one header field would be
+        per-height DB I/O on the serialized consensus thread; the store
+        fallback only runs cold after a restart."""
+        if height == 1:
+            return True
+        if self._app_hash_changed is not None:
+            return self._app_hash_changed
+        last = self.block_store.load_block(height - 1)
+        # last block's header carries the app hash BEFORE its execution;
+        # if the live app hash differs, that block changed it
+        return last is None or self.state.app_hash != last.header.app_hash
+
+    def _handle_txs_available(self, item: _TxsAvailable) -> None:
+        """Mempool has txs: leave the NewRound hold (reference
+        `handleTxsAvailable` — enterPropose for the current round)."""
+        if item.height != self.height or self.step != STEP_NEW_ROUND:
+            return
+        self._enter_propose(self.height, self.round)
+
+    def _start_heartbeat(self, height: int, round_: int) -> None:
+        """Sign + gossip ProposalHeartbeat every 2s while holding in
+        NewRound (reference `proposalHeartbeat` :820-847)."""
+        if self.priv_validator is None or self._replay_mode:
+            return
+        from tendermint_tpu.types.proposal import Heartbeat
+
+        def run():
+            seq = 0
+            addr = self.priv_validator.address
+            idx = self.validators.index_of(addr)
+            while not self._stopped.is_set():
+                with self._mtx:
+                    if (self.height != height or self.round > round_ or
+                            self.step > STEP_NEW_ROUND):
+                        return
+                    chain_id = self.state.chain_id
+                hb = Heartbeat(validator_address=addr, validator_index=idx,
+                               height=height, round=round_, sequence=seq)
+                sig = self.priv_validator.sign_heartbeat(chain_id, hb)
+                hb = Heartbeat(validator_address=addr, validator_index=idx,
+                               height=height, round=round_, sequence=seq,
+                               signature=sig)
+                self.evsw.fire(ev.PROPOSAL_HEARTBEAT, hb)
+                self._broadcast(M.ProposalHeartbeatMessage(hb))
+                seq += 1
+                if self._stopped.wait(PROPOSAL_HEARTBEAT_INTERVAL):
+                    return
+
+        threading.Thread(target=run, daemon=True,
+                         name=f"heartbeat-{height}").start()
 
     def _enter_propose(self, height: int, round_: int) -> None:
         if (height != self.height or round_ < self.round or

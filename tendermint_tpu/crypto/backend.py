@@ -186,9 +186,21 @@ class TpuBackend:
         """Grouped verify shipping only (sig, val_idx, tmpl_idx) lanes
         plus T message templates; messages and pubkeys assemble on
         device (see ops.ed25519.verify_grouped_templated)."""
+        return self.verify_grouped_templated_async(
+            set_key, val_pubs, val_idx, tmpl_idx, templates, sigs)()
+
+    def verify_grouped_templated_async(self, set_key, val_pubs, val_idx,
+                                       tmpl_idx, templates, sigs):
+        """Dispatching half of `verify_grouped_templated`: uploads the
+        lanes and queues the device step WITHOUT waiting, returning a
+        zero-arg closure that blocks for the result.  A pipeline caller
+        dispatches window k+1 before collecting window k, so the
+        multi-MB lane upload (the dominant per-window cost over a slow
+        host<->device link) overlaps the previous window's compute.
+        """
         n = len(val_idx)
         if n == 0:
-            return np.zeros(0, dtype=bool)
+            return lambda: np.zeros(0, dtype=bool)
         tbl, pub_ok, v, vp_dev = self._set_tables(set_key, val_pubs)
         if v != len(val_pubs):
             raise ValueError(
@@ -198,8 +210,9 @@ class TpuBackend:
         if self._mesh_eligible(b):
             # mesh path: assemble messages host-side and ride the
             # sharded kernel (templates are tiny; the win is moot there)
-            return self.verify_grouped(set_key, val_pubs, val_idx,
-                                       templates[tmpl_idx], sigs)
+            out = self.verify_grouped(set_key, val_pubs, val_idx,
+                                      templates[tmpl_idx], sigs)
+            return lambda: out
         pad = b - n
         if pad:
             val_idx = np.concatenate([val_idx, np.repeat(val_idx[:1], pad)])
@@ -214,16 +227,21 @@ class TpuBackend:
                                      np.uint8)])
         jnp = self._jnp
         t0 = time.perf_counter()
-        out = np.asarray(self._dev.verify_grouped_templated_jit(
+        dev_out = self._dev.verify_grouped_templated_jit(
             tbl, pub_ok, vp_dev, jnp.asarray(val_idx.astype(np.int32)),
             jnp.asarray(tmpl_idx.astype(np.int32)),
-            jnp.asarray(templates), jnp.asarray(sigs)))
-        REGISTRY.device_step_seconds.observe(time.perf_counter() - t0)
-        REGISTRY.sigs_requested.inc(n)
-        REGISTRY.sigs_verified.inc(int(out[:n].sum()))
-        REGISTRY.verify_batches.inc()
-        REGISTRY.batch_occupancy.observe(n / b)
-        return out[:n]
+            jnp.asarray(templates), jnp.asarray(sigs))
+
+        def collect() -> np.ndarray:
+            out = np.asarray(dev_out)
+            REGISTRY.device_step_seconds.observe(time.perf_counter() - t0)
+            REGISTRY.sigs_requested.inc(n)
+            REGISTRY.sigs_verified.inc(int(out[:n].sum()))
+            REGISTRY.verify_batches.inc()
+            REGISTRY.batch_occupancy.observe(n / b)
+            return out[:n]
+
+        return collect
 
     def precompile(self, set_key: bytes, val_pubs: np.ndarray,
                    shapes: list[tuple[int, int]], msg_len: int) -> None:
@@ -407,3 +425,17 @@ def verify_grouped_templated(set_key: bytes, val_pubs, val_idx, tmpl_idx,
         return fn(set_key, val_pubs, val_idx, tmpl_idx, templates, sigs)
     return verify_grouped(set_key, val_pubs, val_idx,
                           templates[tmpl_idx], sigs)
+
+
+def verify_grouped_templated_async(set_key: bytes, val_pubs, val_idx,
+                                   tmpl_idx, templates, sigs):
+    """Pipelined form: dispatch now, collect via the returned closure.
+    Backends without async dispatch run synchronously and hand back the
+    finished result."""
+    be = get_backend()
+    fn = getattr(be, "verify_grouped_templated_async", None)
+    if fn is not None:
+        return fn(set_key, val_pubs, val_idx, tmpl_idx, templates, sigs)
+    out = verify_grouped_templated(set_key, val_pubs, val_idx, tmpl_idx,
+                                   templates, sigs)
+    return lambda: out

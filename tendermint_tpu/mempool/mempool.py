@@ -134,6 +134,10 @@ class Mempool:
         with self._lock:
             return len(self._txs)
 
+    def height(self) -> int:
+        """Last committed height this pool was updated to (gossip gate)."""
+        return self._height
+
     def reap(self, max_txs: int) -> list[bytes]:
         """First N txs in order for a proposal (reference `:298-324`)."""
         with self._lock:

@@ -71,6 +71,16 @@ class MempoolReactor(Reactor):
         sent: set[bytes] = set()
         while not stop.is_set():
             try:
+                # height-gating (reference `:111+` waits on peer height):
+                # a peer still fast-syncing (its consensus height more
+                # than one block behind the pool's) would only discard
+                # tx pushes — hold gossip until it is nearly caught up
+                ps = peer.get("consensus")
+                if ps is not None:
+                    pool_h = self.mempool.height()
+                    if pool_h > 0 and ps.prs.height < pool_h:
+                        stop.wait(BROADCAST_SLEEP * 5)
+                        continue
                 txs = self.mempool.txs_after(0)
                 live = set()
                 pushed = False

@@ -58,6 +58,12 @@ def pt_add_affine(Q, aff):
 
     The (1, 1, 0) entry acts as the identity, so window tables need no
     special case for digit 0.
+
+    Operands are kept fully carried (the |limb| <= 512 invariant) between
+    steps: `fe.mul`'s f32 convolution needs every column sum below 2^24,
+    which the invariant guarantees (tests/test_field.py
+    `test_mixed_add_interval_bounds` proves it by exact per-limb interval
+    propagation).
     """
     x1, y1, z1, t1 = Q
     yplusx, yminusx, xy2d = aff
@@ -289,43 +295,71 @@ def scalar_mul_comb(tbl: jnp.ndarray, val_idx: jnp.ndarray,
     return acc
 
 
+BASE_WBITS = 12                      # fixed-base comb window width
+BASE_WINDOWS = -(-256 // BASE_WBITS)  # 22 windows cover 256 bits
+
+
 @functools.lru_cache(maxsize=None)
 def _base_table() -> np.ndarray:
-    """np.uint8[32, 256, 3, 32]: window w, digit j -> affine precomp of
-    j * 2^(8w) * B as (y+x, y-x, 2d*x*y) canonical byte rows (uint8 storage
-    quarters the per-window gather traffic).  Built once host-side from
-    the golden bigint reference."""
+    """np.uint8[22, 4096, 3, 32]: window w, digit j -> affine precomp of
+    j * 2^(12w) * B as (y+x, y-x, 2d*x*y) canonical byte rows.
+
+    12-bit windows (VERDICT r3 lever): 22 mixed adds per [s]B instead of
+    the 8-bit comb's 32 — the ~8.6 MB table stays device-resident.  Built
+    once host-side from the golden bigint reference (~90k bigint adds,
+    well under a second) and lru-cached for the process.
+    """
+    nwin, ndig = BASE_WINDOWS, 1 << BASE_WBITS
     pts = []
     P = ref.BASE
-    for w in range(32):
+    for w in range(nwin):
         acc = ref.IDENT
-        for _ in range(256):
+        for _ in range(ndig):
             pts.append(acc)
             acc = ref.pt_add(acc, P)
-        P = acc  # acc == 256 * P == 2^(8(w+1)) * B
-    # Montgomery batch inversion: one modexp for all 8192 Z coordinates.
+        P = acc  # acc == 2^BASE_WBITS * P == 2^(12(w+1)) * B
+    # Montgomery batch inversion: one modexp for all Z coordinates.
     prefix, run = [], 1
     for p in pts:
         prefix.append(run)
         run = run * p[2] % ref.P
     run_inv = pow(run, ref.P - 2, ref.P)
-    tbl = np.zeros((32, 256, 3, fe.NLIMBS), dtype=np.uint8)
+    tbl = np.zeros((nwin, ndig, 3, fe.NLIMBS), dtype=np.uint8)
     for idx in range(len(pts) - 1, -1, -1):
         x, y, z, _ = pts[idx]
         zi = run_inv * prefix[idx] % ref.P
         run_inv = run_inv * z % ref.P
         xa, ya = x * zi % ref.P, y * zi % ref.P
-        w, j = divmod(idx, 256)
+        w, j = divmod(idx, ndig)
         tbl[w, j, 0] = fe.int_to_limbs((ya + xa) % ref.P)
         tbl[w, j, 1] = fe.int_to_limbs((ya - xa) % ref.P)
         tbl[w, j, 2] = fe.int_to_limbs(2 * fe.D * xa * ya % ref.P)
     return tbl
 
 
+# Static per-window byte/shift layout for 12-bit digit extraction: window
+# w covers bits [12w, 12w+12), i.e. bytes lo=3w//2 (shifted by 0 or 4)
+# and lo+1; the top window only has 4 real bits (masked hi byte).
+_D12_LO = np.array([(12 * w) // 8 for w in range(BASE_WINDOWS)])
+_D12_ODD = np.array([(12 * w) % 8 == 4 for w in range(BASE_WINDOWS)])
+_D12_HI = np.minimum(_D12_LO + 1, fe.NLIMBS - 1)
+_D12_HI_OK = (_D12_LO + 1 <= fe.NLIMBS - 1).astype(np.int32)
+
+
+def digits12(s: jnp.ndarray) -> jnp.ndarray:
+    """Bytes/limbs [..., 32] -> 22 little-endian 12-bit digits [..., 22]."""
+    x = s.astype(jnp.int32)
+    lo = jnp.take(x, jnp.asarray(_D12_LO), axis=-1)
+    hi = jnp.take(x, jnp.asarray(_D12_HI), axis=-1) * jnp.asarray(_D12_HI_OK)
+    even = lo + ((hi & 0xF) << 8)
+    odd = (lo >> 4) + (hi << 4)
+    return jnp.where(jnp.asarray(_D12_ODD), odd, even)
+
+
 def scalar_mul_base(s: jnp.ndarray) -> tuple:
-    """[s]B via the fixed-base comb: 32 mixed adds, zero doublings."""
-    tbl = jnp.asarray(_base_table())           # [32, 256, 3, 32]
-    digits = jnp.moveaxis(s.astype(jnp.int32), -1, 0)  # [32, ...]
+    """[s]B via the 12-bit fixed-base comb: 22 mixed adds, zero doublings."""
+    tbl = jnp.asarray(_base_table())           # [22, 4096, 3, 32]
+    digits = jnp.moveaxis(digits12(s), -1, 0)  # [22, ...]
 
     def body(acc, xs):
         digit, tblw = xs

@@ -36,20 +36,28 @@ _KL_LIMBS = [_int_to_limbs(k * L, 33) for k in (16, 8, 4, 2, 1)]
 
 
 def _carry(x: jnp.ndarray) -> jnp.ndarray:
-    """One signed carry pass: limbs -> [0,255] plus an appended carry limb.
+    """Signed exact carry: limbs -> [0,255] plus an appended top limb.
 
-    Exact for any int32 limbs with |limb| < 2^23 (carry magnitude stays far
-    below int32 overflow).  Arithmetic right shift == floor division, so
-    negative limbs normalize correctly; only the final limb may be negative.
+    Exact for any int32 limbs with |limb| < 2^23; only the final limb may
+    be negative (it absorbs the net overflow/underflow).  Fully parallel
+    (VERDICT r3): 4 shift-and-fold passes leave body limbs in [-1, 256],
+    a +1-per-limb lift makes them nonnegative for the Kogge-Stone exact
+    normalize, and a borrow-lookahead subtraction takes the lift back out
+    — ~20 vector ops instead of an n-step sequential chain.
     """
-    outs = []
-    c = jnp.zeros_like(x[..., 0])
-    for i in range(x.shape[-1]):
-        v = x[..., i] + c
-        c = v >> 8
-        outs.append(v & 0xFF)
-    outs.append(c)
-    return jnp.stack(outs, axis=-1)
+    from tendermint_tpu.ops.field import ks_normalize, ks_sub_const
+
+    body, top = x, jnp.zeros_like(x[..., 0])
+    for _ in range(4):
+        c = body >> 8
+        body = (body & 0xFF).at[..., 1:].add(c[..., :-1])
+        top = top + c[..., -1]
+    # body in [-1, 256]: lift by +1, normalize, subtract the lift (the
+    # lookahead conditions live in ONE place — field.ks_normalize /
+    # ks_sub_const)
+    b, t1 = ks_normalize(body + 1)
+    r, t2 = ks_sub_const(b, jnp.ones_like(b))
+    return jnp.concatenate([r, (top + t1 - t2)[..., None]], axis=-1)
 
 
 def _mul_const(a: jnp.ndarray, const: np.ndarray) -> jnp.ndarray:
@@ -72,14 +80,10 @@ def _fold(x: jnp.ndarray) -> jnp.ndarray:
 
 
 def _csub(x: jnp.ndarray, const: np.ndarray) -> jnp.ndarray:
-    """x - const if that is >= 0 else x, via a borrow chain (33 limbs)."""
-    outs = []
-    borrow = jnp.zeros_like(x[..., 0])
-    for i in range(x.shape[-1]):
-        v = x[..., i] - int(const[i]) - borrow
-        borrow = (v < 0).astype(jnp.int32)
-        outs.append(v + (borrow << 8))
-    diff = jnp.stack(outs, axis=-1)
+    """x - const if that is >= 0 else x, via borrow lookahead (33 limbs)."""
+    from tendermint_tpu.ops.field import ks_sub_const
+
+    diff, borrow = ks_sub_const(x, jnp.asarray(const))
     return jnp.where((borrow == 0)[..., None], diff, x)
 
 
@@ -97,12 +101,11 @@ def reduce512(h: jnp.ndarray) -> jnp.ndarray:
 
 
 def lt_const(b: jnp.ndarray, const_limbs: np.ndarray) -> jnp.ndarray:
-    """Little-endian bytes/limbs [..., N] < constant -> bool[...] (borrow chain)."""
-    x = b.astype(jnp.int32)
-    borrow = jnp.zeros_like(x[..., 0])
-    for i in range(x.shape[-1]):
-        v = x[..., i] - int(const_limbs[i]) - borrow
-        borrow = (v < 0).astype(jnp.int32)
+    """Little-endian bytes/limbs [..., N] < constant -> bool[...]
+    (borrow lookahead: only the final borrow is needed)."""
+    from tendermint_tpu.ops.field import ks_sub_const
+
+    _, borrow = ks_sub_const(b.astype(jnp.int32), jnp.asarray(const_limbs))
     return borrow == 1
 
 

@@ -114,6 +114,9 @@ class MConnection:
         self._err_lock = threading.Lock()
         self._last_decay = time.monotonic()
         self._threads: list[threading.Thread] = []
+        from tendermint_tpu.utils.flowrate import Meter
+        self.send_monitor = Meter()
+        self.recv_monitor = Meter()
 
     # -- lifecycle ------------------------------------------------------
     def start(self) -> None:
@@ -220,6 +223,7 @@ class MConnection:
                         FLAG_EOF if eof else 0, len(chunk)) + chunk
                     self._send_limiter.consume(len(pkt))
                     self.conn.write(pkt)
+                    self.send_monitor.update(len(pkt))
                     REGISTRY.msgs_sent.inc()
                 self._decay()
                 now = time.monotonic()
@@ -248,6 +252,7 @@ class MConnection:
                     ">BBH", self.conn.read_exact(4))
                 payload = self.conn.read_exact(ln) if ln else b""
                 self._recv_limiter.consume(5 + ln)
+                self.recv_monitor.update(5 + ln)
                 ch = self._channels.get(ch_id)
                 if ch is None:
                     raise ValueError(f"packet for unknown channel {ch_id}")
@@ -265,8 +270,12 @@ class MConnection:
             self._die(e)
 
     def status(self) -> dict:
-        """Channel-occupancy snapshot (reference ConnectionStatus)."""
+        """Flowrate + channel-occupancy snapshot (reference
+        `ConnectionStatus`, p2p/connection.go:485-515: SendMonitor /
+        RecvMonitor status plus per-channel state)."""
         return {
+            "send_monitor": self.send_monitor.status(),
+            "recv_monitor": self.recv_monitor.status(),
             "channels": {
                 ch.desc.id: {
                     "priority": ch.desc.priority,

@@ -116,6 +116,26 @@ class Switch:
         with self._peers_lock:
             return self._peers.get(peer_id)
 
+    def net_info(self) -> dict:
+        """Listener + per-peer connection snapshots for the `net_info`
+        RPC (reference `rpc/core/net.go` NetInfo: listening, listeners,
+        peers with NodeInfo + ConnectionStatus incl. flowrate)."""
+        with self._peers_lock:
+            peers = list(self._peers.values())
+        return {
+            "listening": self._listener is not None,
+            "listeners": ([str(self._listener.addr)]
+                          if self._listener is not None else []),
+            "n_peers": len(peers),
+            "peers": [{
+                "id": p.id,
+                "moniker": p.node_info.moniker,
+                "listen_addr": p.node_info.listen_addr,
+                "is_outbound": p.outbound,
+                "connection_status": p.mconn.status(),
+            } for p in peers],
+        }
+
     def broadcast(self, ch_id: int, msg: bytes) -> list[str]:
         """Non-blocking try-send to every peer; returns ids that accepted
         (reference `Broadcast` :368-380)."""

@@ -125,7 +125,10 @@ class State:
         """Advance past one block (reference `state/state.go:137-168`):
         Validators shift to LastValidators; EndBlock diffs apply to the
         next set, which also rotates proposer priority."""
-        prev_vals = self.validators.copy()
+        # the outgoing set is aliased, not copied: every mutation site in
+        # the tree (increment_accum / apply_updates callers) copies first,
+        # so the object is frozen once it becomes last_validators
+        prev_vals = self.validators
         next_vals = self.validators.copy()
         if diffs:
             next_vals.apply_updates(diffs)

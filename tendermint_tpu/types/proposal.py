@@ -74,12 +74,17 @@ class Heartbeat:
             parts_total=self.sequence)
 
     def encode(self) -> bytes:
-        return (lp_bytes(self.validator_address) + u32(self.validator_index) +
+        # index -1 = sender is not a validator (reference Heartbeat
+        # carries ValidatorIndex -1 for observers); shift like the other
+        # minus-one-able wire fields
+        return (lp_bytes(self.validator_address) +
+                u32(self.validator_index + 1) +
                 u64(self.height) + u32(self.round) + u64(self.sequence) +
                 lp_bytes(self.signature))
 
     @classmethod
     def decode(cls, r: Reader) -> "Heartbeat":
-        return cls(validator_address=r.lp_bytes(), validator_index=r.u32(),
+        return cls(validator_address=r.lp_bytes(),
+                   validator_index=r.u32() - 1,
                    height=r.u64(), round=r.u32(), sequence=r.u64(),
                    signature=r.lp_bytes())

@@ -53,8 +53,20 @@ class Validator:
     def address(self) -> bytes:
         return self.pub_key.address
 
+    @property
+    def sort_key(self) -> bytes:
+        """Cached `_neg_addr(address)` — the proposer-rotation tie-break
+        runs V comparisons per block, so this is per-block hot."""
+        k = self.__dict__.get("_sort_key")
+        if k is None:
+            k = self.__dict__["_sort_key"] = _neg_addr(self.address)
+        return k
+
     def copy(self) -> "Validator":
-        return Validator(self.pub_key, self.voting_power, self.accum)
+        v = Validator(self.pub_key, self.voting_power, self.accum)
+        if "_sort_key" in self.__dict__:
+            v.__dict__["_sort_key"] = self.__dict__["_sort_key"]
+        return v
 
     def encode(self) -> bytes:
         return (lp_bytes(self.pub_key.bytes_) + i64(self.voting_power) +
@@ -114,6 +126,12 @@ class ValidatorSet:
         new._by_addr = dict(self._by_addr)
         new._proposer = (None if self._proposer is None else
                          new.validators[self._by_addr[self._proposer.address]])
+        # membership-derived caches survive a copy (invalidated only by
+        # apply_updates); the hash also survives accum rotation because
+        # hash_bytes excludes accum
+        for attr in ("_set_key", "_pubs_mat", "_hash"):
+            if attr in self.__dict__:
+                new.__dict__[attr] = self.__dict__[attr]
         return new
 
     # -- proposer rotation ---------------------------------------------
@@ -126,9 +144,10 @@ class ValidatorSet:
             for v in self.validators:
                 v.accum += v.voting_power
             proposer = max(self.validators,
-                           key=lambda v: (v.accum, _neg_addr(v.address)))
+                           key=lambda v: (v.accum, v.sort_key))
             proposer.accum -= self._total
             self._proposer = proposer
+        self.__dict__.pop("_enc", None)    # accum is part of encode()
 
     @property
     def proposer(self) -> Validator:
@@ -138,8 +157,14 @@ class ValidatorSet:
     # -- hashing / codec ------------------------------------------------
     def hash(self) -> bytes:
         """Merkle root over validators (reference
-        `types/validator_set.go:140-149`)."""
-        return merkle.root([v.hash_bytes() for v in self.validators])
+        `types/validator_set.go:140-149`).  Cached: recomputing this tree
+        per block was ~1/3 of fast-sync apply; accum rotation does not
+        change it (hash_bytes excludes accum), only apply_updates does."""
+        h = self.__dict__.get("_hash")
+        if h is None:
+            h = self.__dict__["_hash"] = merkle.root(
+                [v.hash_bytes() for v in self.validators])
+        return h
 
     def set_key(self) -> bytes:
         """Stable identity for crypto-backend table caching: a digest of
@@ -168,7 +193,13 @@ class ValidatorSet:
         every committed block, so a per-validator Python loop (~200 calls
         at V=100) is real per-block cost in fast-sync replay.  Entries are
         fixed 52-byte rows (u32 len=32 || pub32 || i64 power || i64 accum)
-        built in one numpy buffer."""
+        built in one numpy buffer.  Cached until accum/membership changes
+        (state persistence encodes the same set up to three times per
+        committed block: state.validators, the height-keyed history row,
+        and next block's last_validators)."""
+        e = self.__dict__.get("_enc")
+        if e is not None:
+            return e
         n = len(self.validators)
         rows = np.zeros((n, 52), dtype=np.uint8)
         rows[:, 0:4] = np.frombuffer(u32(32) * n,
@@ -181,7 +212,8 @@ class ValidatorSet:
             [v.accum for v in self.validators],
             dtype=">i8").view(np.uint8).reshape(n, 8)
         prop = self.index_of(self._proposer.address) if self._proposer else -1
-        return u32(n) + rows.tobytes() + i64(prop)
+        e = self.__dict__["_enc"] = u32(n) + rows.tobytes() + i64(prop)
+        return e
 
     @classmethod
     def decode(cls, r: Reader) -> "ValidatorSet":
@@ -218,6 +250,8 @@ class ValidatorSet:
         self._by_addr = {v.address: i for i, v in enumerate(self.validators)}
         self._set_key = None     # membership/power changed: invalidate
         self._pubs_mat = None    # the grouped-verify identity + key matrix
+        self.__dict__.pop("_hash", None)
+        self.__dict__.pop("_enc", None)
         if (self._proposer is not None and
                 self._proposer.address not in self._by_addr):
             self._proposer = None

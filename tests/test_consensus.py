@@ -207,3 +207,85 @@ def test_proposal_flow_events():
         assert want in steps
     assert steps.index(STEP_PROPOSE) < steps.index(STEP_PREVOTE) \
         < steps.index(STEP_PRECOMMIT) < steps.index(STEP_COMMIT)
+
+
+def test_wait_for_txs_and_proposal_heartbeat(monkeypatch):
+    """create_empty_blocks = false (reference consensus/state.go:793-847):
+    after the proof block commits, the node holds in NewRound signing
+    ProposalHeartbeats until the mempool reports txs, then proposes a
+    block containing them."""
+    import tendermint_tpu.consensus.state as cs_mod
+    monkeypatch.setattr(cs_mod, "PROPOSAL_HEARTBEAT_INTERVAL", 0.05)
+    privs, vs = make_validators(1)
+    gen = make_genesis(CHAIN, privs)
+    cfg = fast_config().consensus
+    cfg.create_empty_blocks = False
+    # nilapp: empty commits keep the app hash stable — with kvstore every
+    # commit changes the hash (height is hashed in), making every block a
+    # proof block and legitimately bypassing the gate
+    cs, mp, bs = _make_cs(privs[0], gen, app="nilapp", cfg=cfg)
+    heartbeats = []
+    cs.evsw.subscribe("t", ev.PROPOSAL_HEARTBEAT, heartbeats.append)
+    sent = []
+    cs.broadcast_cb = sent.append
+    cs.start()
+    try:
+        # height 1 is a proof block (genesis app hash) and commits empty;
+        # then the node must HOLD: no empty block 2
+        assert _wait_height(cs, 1), f"stuck at {bs.height}"
+        time.sleep(0.6)
+        assert bs.height == 1, "empty block created despite gate"
+        # heartbeats flowed while holding, signed by our validator
+        assert heartbeats, "no ProposalHeartbeat fired"
+        hb = heartbeats[-1]
+        assert hb.height == 2 and hb.validator_address == privs[0].address
+        assert privs[0].pub_key.verify(hb.sign_bytes(CHAIN), hb.signature)
+        assert any(isinstance(m, M.ProposalHeartbeatMessage) for m in sent)
+        # a tx unblocks the proposer
+        mp.check_tx(b"hb=unblock")
+        assert _wait_height(cs, 2, timeout=10), f"stuck at {bs.height}"
+        assert b"hb=unblock" in bs.load_block(2).txs
+        # and it holds again once the pool drains (nilapp: hash stable)
+        time.sleep(0.4)
+        assert bs.height <= 3
+    finally:
+        cs.stop()
+
+
+def test_wait_for_txs_drains_leftover_pool(monkeypatch):
+    """A tx already sitting in the pool when the hold begins (its
+    notification was consumed during the previous commit) must still
+    unblock proposing: the hold consults mempool.size() directly."""
+    import tendermint_tpu.consensus.state as cs_mod
+    monkeypatch.setattr(cs_mod, "PROPOSAL_HEARTBEAT_INTERVAL", 0.05)
+    privs, vs = make_validators(1)
+    gen = make_genesis(CHAIN, privs)
+    cfg = fast_config().consensus
+    cfg.create_empty_blocks = False
+    cfg.max_block_size_txs = 1         # one tx per block: leftovers remain
+    cs, mp, bs = _make_cs(privs[0], gen, app="nilapp", cfg=cfg)
+    cs.start()
+    try:
+        assert _wait_height(cs, 1), f"stuck at {bs.height}"   # proof block
+        # both txs admitted back-to-back: ONE notification covers both
+        mp.check_tx(b"t1=a")
+        mp.check_tx(b"t2=b")
+        # blocks 2 and 3 must each carry one tx; block 3's hold has no
+        # fresh notification — only the size() check unblocks it
+        assert _wait_height(cs, 3, timeout=10), f"stuck at {bs.height}"
+        assert bs.load_block(2).txs == [b"t1=a"]
+        assert bs.load_block(3).txs == [b"t2=b"]
+    finally:
+        cs.stop()
+
+
+def test_heartbeat_codec_non_validator_index():
+    """Observers heartbeat with validator_index -1 (reference semantics);
+    the wire codec must round-trip it."""
+    from tendermint_tpu.consensus.messages import (ProposalHeartbeatMessage,
+                                                   decode_msg, encode_msg)
+    from tendermint_tpu.types.proposal import Heartbeat
+    hb = Heartbeat(validator_address=b"\x01" * 20, validator_index=-1,
+                   height=7, round=2, sequence=3, signature=b"\x05" * 64)
+    out = decode_msg(encode_msg(ProposalHeartbeatMessage(hb)))
+    assert out.heartbeat == hb

@@ -252,3 +252,98 @@ def test_fast_sync_verify_ahead_overlap():
         assert bc.state.app_hash == hashes[n - 1]
     finally:
         src_sw.stop(); sync_sw.stop()
+
+
+def test_pool_evicts_slow_drip_peer(monkeypatch):
+    """Rate-based eviction (reference blockchain/pool.go:100-118
+    minRecvRate): a peer that answers each request just inside the redo
+    timeout — so the redo counter never fires — but at a trickle rate
+    must be evicted; the honest fast peer keeps the window moving."""
+    import tendermint_tpu.blockchain.pool as pool_mod
+    monkeypatch.setattr(pool_mod, "STARVE_AGE", 0.15)
+    evicted = []
+    pool = BlockPool(start_height=1, min_recv_rate=10_240)
+    pool.on_evict = lambda p, r: evicted.append((p, r))
+    pool.set_peer_height("drip", 400)
+    pool.set_peer_height("fast", 400)
+
+    deadline = time.time() + 10
+    drip_last = 0.0
+    while not evicted and time.time() < deadline:
+        for h, p in pool.schedule():
+            if p == "fast":
+                pool.add_block("fast", FakeBlock(h))
+                pool.record_bytes("fast", 4096)   # ~healthy block size
+        # drip answers ONE outstanding request every 0.2s with 40 bytes:
+        # inside any redo timeout, far under 10 KB/s
+        now = time.time()
+        if now - drip_last >= 0.2:
+            drip_last = now
+            for h, s in list(pool._slots.items()):
+                if s.peer_id == "drip" and s.block is None:
+                    pool.add_block("drip", FakeBlock(h))
+                    pool.record_bytes("drip", 40)
+                    break
+        time.sleep(0.02)
+    assert evicted and evicted[0][0] == "drip", evicted
+    assert "fast" in pool._peers       # honest peer survives
+    # the window keeps advancing on the fast peer alone
+    n0 = pool.next_height
+    for h, p in pool.schedule():
+        if p == "fast":
+            pool.add_block("fast", FakeBlock(h))
+    got = pool.peek_contiguous(64)
+    assert len(got) > 0
+    pool.pop(len(got))
+    assert pool.next_height > n0
+
+
+def test_net_info_exposes_flowrate():
+    """net_info carries per-connection send/recv flowrate snapshots
+    (reference p2p/connection.go:485-515 ConnectionStatus)."""
+    privs, vs = make_validators(1)
+    gen = make_genesis(CHAIN, privs)
+
+    def node():
+        st = get_state(MemDB(), gen)
+        conns = ClientCreator("kvstore").new_app_conns()
+        bs = BlockStore(MemDB())
+        r = BlockchainReactor(st, conns.consensus, bs, fast_sync=False)
+        return make_switch(CHAIN, {"blockchain": r})
+
+    sw1, sw2 = node(), node()
+    sw1.start(); sw2.start()
+    try:
+        connect_switches(sw1, sw2)
+        info = sw1.net_info()
+        assert info["n_peers"] == 1
+        cstat = info["peers"][0]["connection_status"]
+        assert "send_monitor" in cstat and "recv_monitor" in cstat
+        assert cstat["recv_monitor"]["total_bytes"] >= 0
+        assert "channels" in cstat
+    finally:
+        sw1.stop(); sw2.stop()
+
+
+def test_pool_rate_eviction_spares_first_block(monkeypatch):
+    """A peer that has not delivered its FIRST block yet must not be
+    rate-evicted (the reference's curRate==0 exclusion): only the redo
+    timeout judges silent peers."""
+    import tendermint_tpu.blockchain.pool as pool_mod
+    monkeypatch.setattr(pool_mod, "STARVE_AGE", 0.05)
+    evicted = []
+    pool = BlockPool(start_height=1, min_recv_rate=10_240)
+    pool.on_evict = lambda p, r: evicted.append(p)
+    pool.set_peer_height("fresh", 10)
+    reqs = pool.schedule()
+    assert reqs
+    time.sleep(0.2)          # outstanding well past STARVE_AGE
+    pool.schedule()
+    assert not evicted, "evicted a peer that never got to deliver"
+    # once it HAS delivered (trickle), the rate check applies
+    h0 = reqs[0][0]
+    pool.add_block("fresh", FakeBlock(h0))
+    pool.record_bytes("fresh", 30)
+    time.sleep(0.2)
+    pool.schedule()
+    assert evicted == ["fresh"]

@@ -135,3 +135,104 @@ def test_batch_inv_sizes_and_zero_lanes():
             else:
                 assert nz[i]
                 assert fe.limbs_to_int(zi[i]) % P == pow(v, P - 2, P), (n, i)
+
+
+def test_mixed_add_interval_bounds():
+    """Exact per-limb interval propagation through pt_add_affine with the
+    f32-convolution `mul`: every column sum must stay below 2^24 (exact
+    in f32 accumulation) for all operand bounds reachable in a comb scan,
+    and the x38 fold plus carry passes must stay exact in int32 and reach
+    a FIXED POINT over arbitrarily long scans.  If anyone changes the
+    mixed-add formulas or the carry discipline, extend this.
+    """
+    NL = fe.NLIMBS
+    EIGHT_P = fe._EIGHT_P.astype(object)
+    BYTE = (np.zeros(NL, dtype=object), np.full(NL, 255, dtype=object))
+
+    def iv_carry(lo, hi, passes):
+        for _ in range(passes):
+            c_lo, c_hi = lo >> 8, hi >> 8           # arithmetic shift
+            lo, hi = np.zeros(NL, dtype=object), np.full(NL, 255, dtype=object)
+            lo[1:] = lo[1:] + c_lo[:-1]
+            hi[1:] = hi[1:] + c_hi[:-1]
+            lo[0] += 38 * c_lo[-1]
+            hi[0] += 38 * c_hi[-1]
+        return lo, hi
+
+    def iv_mul(a, b):
+        a_lo, a_hi = a
+        b_lo, b_hi = b
+        col_lo = np.zeros(2 * NL - 1, dtype=object)
+        col_hi = np.zeros(2 * NL - 1, dtype=object)
+        for i in range(NL):
+            for j in range(NL):
+                prods = [a_lo[i] * b_lo[j], a_lo[i] * b_hi[j],
+                         a_hi[i] * b_lo[j], a_hi[i] * b_hi[j]]
+                col_lo[i + j] += min(prods)
+                col_hi[i + j] += max(prods)
+        # f32 accumulation in the conv is exact only below 2^24
+        assert max(abs(int(v)) for v in np.concatenate([col_lo, col_hi])) \
+            < 2**24, "f32 conv column overflow"
+        lo = col_lo[:NL].copy()
+        hi = col_hi[:NL].copy()
+        lo[:NL - 1] += 38 * col_lo[NL:]
+        hi[:NL - 1] += 38 * col_hi[NL:]
+        assert max(abs(int(v)) for v in np.concatenate([lo, hi])) < 2**31, \
+            "int32 fold overflow"
+        return iv_carry(lo, hi, 4)
+
+    def iv_add(a, b):
+        return iv_carry(a[0] + b[0], a[1] + b[1], 2)
+
+    def iv_sub(a, b):
+        return iv_carry(a[0] - b[1] + EIGHT_P, a[1] - b[0] + EIGHT_P, 2)
+
+    def iv_dbl(a):
+        return iv_carry(a[0] * 2, a[1] * 2, 2)
+
+    def widen(a, b):
+        return (np.minimum(a[0], b[0]), np.maximum(a[1], b[1]))
+
+    # seed: accumulator starts at the identity (limbs in [0, 1])
+    acc = tuple((np.zeros(NL, dtype=object), np.full(NL, 1, dtype=object))
+                for _ in range(4))
+    prev = None
+    for it in range(60):
+        x1, y1, z1, t1 = acc
+        a = iv_mul(iv_sub(y1, x1), BYTE)
+        b = iv_mul(iv_add(y1, x1), BYTE)
+        c = iv_mul(t1, BYTE)
+        d = iv_dbl(z1)
+        e, f = iv_sub(b, a), iv_sub(d, c)
+        g, h = iv_add(d, c), iv_add(b, a)
+        out = (iv_mul(e, f), iv_mul(g, h), iv_mul(f, g), iv_mul(e, h))
+        acc = tuple(widen(p, q) for p, q in zip(acc, out))
+        if prev is not None and all(
+                np.array_equal(p[0], q[0]) and np.array_equal(p[1], q[1])
+                for p, q in zip(prev, acc)):
+            break
+        prev = acc
+    else:
+        raise AssertionError("mixed-add intervals did not converge")
+
+
+def test_canonical_adversarial_residuals():
+    """canonical()'s parallel path: values engineered to exercise the
+    +40/-40 lift, the 2^256 wrap fold, and both conditional subtractions
+    of p — compared against bigint reduction."""
+    cases = []
+    # long propagate chains: 0xFF.. runs, p-1, p, p+1, 2p-1, 2p, 2p+38
+    for v in [0, 1, P - 1, P, P + 1, 2 * P - 1, 2 * P, 2**256 - 1,
+              2**256 - 38, 2**256 - 39, (1 << 255) - 1]:
+        cases.append(fe.int_to_limbs(v % 2**256))
+    # limbs at the carry residual extremes seen after fe.carry (|.| <= 512
+    # invariant inputs); value must stay nonnegative
+    neg = np.full(fe.NLIMBS, -1, dtype=np.int32)
+    neg[31] = 300   # value = 300*2^248 - (2^248-1)/255-ish: positive
+    cases.append(neg)
+    arr = jnp.asarray(np.stack(cases))
+    vals = [sum(int(c[i]) << (8 * i) for i in range(fe.NLIMBS)) for c in cases]
+    got = np.asarray(fe.canonical(arr))
+    for i, v in enumerate(vals):
+        assert fe.limbs_to_int(got[i]) == v % P, i
+        assert got[i].max() <= 255 and got[i].min() >= 0

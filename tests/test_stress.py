@@ -1,0 +1,107 @@
+"""Flake-hunting stress tier — the reference's `make test100` analog
+(reference `Makefile:38-39` runs the suite 100x; `make test_race` hunts
+interleavings).  Python has no race detector, so this tier attacks the
+same bug class differently: the gossip-liveness scenarios re-run many
+times WHILE spinner threads hold the GIL hostage, reproducing the
+scheduler pressure that starved the 20ms polling loops (round-3 flake in
+`test_late_joiner_catches_up_through_gossip` — failed in full-suite
+runs, passed in isolation).
+
+Reps default low to keep the suite's wall-clock sane; CI or a flake hunt
+sets STRESS_REPS=50.
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+from tendermint_tpu.crypto import backend as cb
+
+from test_reactor import _make_net, _wait_height, connect_switches
+
+REPS = int(os.environ.get("STRESS_REPS", "6"))
+LOAD_THREADS = int(os.environ.get("STRESS_LOAD_THREADS", "3"))
+WAIT = float(os.environ.get("STRESS_WAIT", "60"))
+
+
+@pytest.fixture(autouse=True)
+def _python_backend():
+    old = cb._current
+    cb.set_backend("python")
+    yield
+    cb._current = old
+
+
+class _GilLoad:
+    """Pure-Python spinner threads: maximal GIL contention, the condition
+    under which polling-based gossip starved."""
+
+    def __init__(self, n):
+        self.n = n
+        self._stop = threading.Event()
+        self._threads = []
+
+    def __enter__(self):
+        for _ in range(self.n):
+            t = threading.Thread(target=self._spin, daemon=True)
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def _spin(self):
+        x = 0
+        while not self._stop.is_set():
+            for _ in range(10_000):
+                x = (x * 1103515245 + 12345) & 0xFFFFFFFF
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2)
+
+
+def _late_joiner_round(rep: int) -> None:
+    nodes, _ = _make_net(4, connect=False)
+    try:
+        for i in range(3):
+            for j in range(i + 1, 3):
+                connect_switches(nodes[i].switch, nodes[j].switch)
+        assert _wait_height(nodes[:3], 2, timeout=WAIT), \
+            (rep, [nd.block_store.height for nd in nodes[:3]])
+        late = nodes[3]
+        for i in range(3):
+            connect_switches(nodes[i].switch, late.switch)
+        assert _wait_height([late], 2, timeout=WAIT), \
+            f"rep {rep}: late joiner stuck at {late.block_store.height}"
+    finally:
+        for nd in nodes:
+            nd.stop()
+
+
+@pytest.mark.slow
+def test_late_joiner_under_gil_load():
+    """The round-3 flake scenario, repeated under GIL pressure.  With the
+    event-driven gossip wakeups this must be deterministic-green; with
+    20ms polling it reliably flaked within a few reps on a loaded box."""
+    t0 = time.time()
+    with _GilLoad(LOAD_THREADS):
+        for rep in range(REPS):
+            _late_joiner_round(rep)
+    print(f"late-joiner x{REPS} under load: {time.time() - t0:.1f}s")
+
+
+@pytest.mark.slow
+def test_four_nodes_converge_under_gil_load():
+    """Steady-state consensus progress must also survive scheduler
+    pressure (the four-node convergence scenario, repeated)."""
+    with _GilLoad(LOAD_THREADS):
+        for rep in range(max(2, REPS // 2)):
+            nodes, _ = _make_net(4)
+            try:
+                assert _wait_height(nodes, 2, timeout=WAIT), \
+                    (rep, [nd.block_store.height for nd in nodes])
+            finally:
+                for nd in nodes:
+                    nd.stop()
