@@ -75,43 +75,50 @@ class ABCIServer:
             conn.close()
 
     def _dispatch(self, msg_type: int, payload: bytes) -> tuple[int, bytes]:
-        app = self.app
-        try:
-            with self._app_lock:
-                if msg_type == wire.MSG_ECHO:
-                    return msg_type, payload
-                if msg_type == wire.MSG_INFO:
-                    return msg_type, wire.encode_response_info(app.info())
-                if msg_type == wire.MSG_SET_OPTION:
-                    r = Reader(payload)
-                    out = app.set_option(r.lp_bytes().decode(),
-                                         r.lp_bytes().decode())
-                    return msg_type, lp_bytes(out.encode())
-                if msg_type == wire.MSG_INIT_CHAIN:
-                    vals = wire.decode_validators(Reader(payload))
-                    app.init_chain(vals)
-                    return msg_type, b""
-                if msg_type == wire.MSG_QUERY:
-                    data, path, height, prove = wire.decode_request_query(
-                        payload)
-                    return msg_type, wire.encode_response_query(
-                        app.query(data, path, height, prove))
-                if msg_type == wire.MSG_BEGIN_BLOCK:
-                    app.begin_block(wire.decode_request_begin_block(payload))
-                    return msg_type, b""
-                if msg_type == wire.MSG_CHECK_TX:
-                    return msg_type, app.check_tx(
-                        Reader(payload).lp_bytes()).encode()
-                if msg_type == wire.MSG_DELIVER_TX:
-                    return msg_type, app.deliver_tx(
-                        Reader(payload).lp_bytes()).encode()
-                if msg_type == wire.MSG_END_BLOCK:
-                    height = Reader(payload).u64()
-                    return msg_type, wire.encode_response_end_block(
-                        app.end_block(height))
-                if msg_type == wire.MSG_COMMIT:
-                    return msg_type, app.commit().encode()
-            return wire.MSG_EXCEPTION, lp_bytes(
-                b"unknown message type %d" % msg_type)
-        except Exception as e:  # app errors must not kill the server
-            return wire.MSG_EXCEPTION, lp_bytes(str(e).encode())
+        return dispatch(self.app, self._app_lock, msg_type, payload)
+
+
+def dispatch(app: Application, app_lock: threading.Lock, msg_type: int,
+             payload: bytes) -> tuple[int, bytes]:
+    """Decode one ABCI request, run it on the app under its lock, encode
+    the response — shared by the socket server and the gRPC server
+    (reference apps attach over either transport, proxy/client.go:65-79)."""
+    try:
+        with app_lock:
+            if msg_type == wire.MSG_ECHO:
+                return msg_type, payload
+            if msg_type == wire.MSG_INFO:
+                return msg_type, wire.encode_response_info(app.info())
+            if msg_type == wire.MSG_SET_OPTION:
+                r = Reader(payload)
+                out = app.set_option(r.lp_bytes().decode(),
+                                     r.lp_bytes().decode())
+                return msg_type, lp_bytes(out.encode())
+            if msg_type == wire.MSG_INIT_CHAIN:
+                vals = wire.decode_validators(Reader(payload))
+                app.init_chain(vals)
+                return msg_type, b""
+            if msg_type == wire.MSG_QUERY:
+                data, path, height, prove = wire.decode_request_query(
+                    payload)
+                return msg_type, wire.encode_response_query(
+                    app.query(data, path, height, prove))
+            if msg_type == wire.MSG_BEGIN_BLOCK:
+                app.begin_block(wire.decode_request_begin_block(payload))
+                return msg_type, b""
+            if msg_type == wire.MSG_CHECK_TX:
+                return msg_type, app.check_tx(
+                    Reader(payload).lp_bytes()).encode()
+            if msg_type == wire.MSG_DELIVER_TX:
+                return msg_type, app.deliver_tx(
+                    Reader(payload).lp_bytes()).encode()
+            if msg_type == wire.MSG_END_BLOCK:
+                height = Reader(payload).u64()
+                return msg_type, wire.encode_response_end_block(
+                    app.end_block(height))
+            if msg_type == wire.MSG_COMMIT:
+                return msg_type, app.commit().encode()
+        return wire.MSG_EXCEPTION, lp_bytes(
+            b"unknown message type %d" % msg_type)
+    except Exception as e:  # app errors must not kill the server
+        return wire.MSG_EXCEPTION, lp_bytes(str(e).encode())

@@ -270,10 +270,20 @@ class BlockchainReactor(Reactor):
                 self.pool.redo(e.height + 1)
                 return False
             except CommitPowerError as e:
-                # votes point at a different block: content tampered
-                log.warn("commit power short; punishing deliverer",
-                         height=e.height)
-                self.pool.redo(e.height)
+                if e.foreign_votes:
+                    # votes endorse a DIFFERENT block: block h itself was
+                    # tampered — its deliverer lied
+                    log.warn("commit votes for another block; punishing "
+                             "deliverer", height=e.height)
+                    self.pool.redo(e.height)
+                else:
+                    # every vote endorses our block but too few are
+                    # present: the commit rides in h+1's LastCommit, so
+                    # the SUCCESSOR's deliverer pruned it — an honest
+                    # deliverer of h must not be evicted for that
+                    log.warn("commit pruned; punishing successor's "
+                             "deliverer", height=e.height)
+                    self.pool.redo(e.height + 1)
                 return False
             verified = (window, parts_list, items)
         window, parts_list, items = verified

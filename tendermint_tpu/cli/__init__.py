@@ -116,13 +116,14 @@ def cmd_testnet(args) -> int:
         home = os.path.join(out, f"node{i}")
         doc.save(os.path.join(home, "genesis.json"))
         # per-node config file: distinct ports, peers pointed at node0
+        base = args.base_port
         cfg = Config()
         cfg.base.home = home
         cfg.base.moniker = f"node{i}"
-        cfg.rpc.laddr = f"tcp://0.0.0.0:{26657 + 2 * i}"
-        cfg.p2p.laddr = f"tcp://0.0.0.0:{26656 + 2 * i}"
+        cfg.rpc.laddr = f"tcp://0.0.0.0:{base + 1 + 2 * i}"
+        cfg.p2p.laddr = f"tcp://0.0.0.0:{base + 2 * i}"
         if i > 0:
-            cfg.p2p.persistent_peers = [f"127.0.0.1:{26656}"]
+            cfg.p2p.persistent_peers = [f"127.0.0.1:{base}"]
         save_config_file(cfg, config_file(home))
     print(f"wrote {n} node homes under {out}")
     return 0
@@ -264,6 +265,7 @@ def main(argv=None) -> int:
     sp.add_argument("--n", type=int, default=4)
     sp.add_argument("--output", default="./mytestnet")
     sp.add_argument("--chain-id", default="")
+    sp.add_argument("--base-port", dest="base_port", type=int, default=26656)
     sp.set_defaults(fn=cmd_testnet)
 
     sp = sub.add_parser("gen_validator", help="print a fresh key")

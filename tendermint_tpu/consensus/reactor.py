@@ -17,7 +17,8 @@ import threading
 import time
 
 from tendermint_tpu.consensus import messages as M
-from tendermint_tpu.consensus.state import (STEP_NEW_HEIGHT,
+from tendermint_tpu.consensus.state import (STEP_COMMIT,
+                                            STEP_NEW_HEIGHT,
                                             STEP_PRECOMMIT_WAIT,
                                             STEP_PREVOTE)
 from tendermint_tpu.p2p.peer import Peer, Reactor
@@ -603,6 +604,17 @@ class ConsensusReactor(Reactor):
             try:
                 rs = self.cs.get_round_state()
                 prs = ps.prs
+                # belt-and-braces for the commit-wait wedge: while we sit
+                # in Commit missing parts, periodically re-advertise our
+                # REAL parts bitmap to this peer so a sender whose model
+                # drifted (marked parts delivered that we dropped
+                # pre-commit) re-sends them
+                if (rs.step == STEP_COMMIT and
+                        rs.proposal_block_parts is not None and
+                        not rs.proposal_block_parts.is_complete()):
+                    msg = self.cs.commit_step_message()
+                    if msg is not None:
+                        peer.try_send(STATE_CHANNEL, M.encode_msg(msg))
                 if rs.height != prs.height or rs.votes is None:
                     continue
                 for type_, getter in ((TYPE_PREVOTE, rs.votes.prevotes),

@@ -393,18 +393,27 @@ class ConsensusState:
         if step == STEP_COMMIT:
             self._broadcast_commit_step()
 
+    def commit_step_message(self):
+        """The current CommitStep advertisement, or None without a parts
+        bitmap — the ONE place this message is assembled (broadcast path
+        and the reactor's per-peer re-advertisement both use it)."""
+        with self._mtx:
+            if self.proposal_block_parts is None:
+                return None
+            return M.CommitStepMessage(
+                height=self.height,
+                parts_total=self.proposal_block_parts.total,
+                parts_bits=tuple(self.proposal_block_parts.bit_array()))
+
     def _broadcast_commit_step(self) -> None:
         """Advertise the REAL parts bitmap while waiting in commit
         (reference sendNewRoundStepMessages also sends CommitStep):
         without it, a catchup sender that believes it already delivered
         every part (its model drifts on a drop or a round-change reset)
         never re-sends, and a node stuck in Commit waits forever."""
-        if self.proposal_block_parts is None:
-            return
-        self._broadcast(M.CommitStepMessage(
-            height=self.height,
-            parts_total=self.proposal_block_parts.total,
-            parts_bits=tuple(self.proposal_block_parts.bit_array())))
+        msg = self.commit_step_message()
+        if msg is not None:
+            self._broadcast(msg)
 
     def _round_step_event(self) -> RoundStepEvent:
         lcr = self.last_commit.round if self.last_commit else -1
@@ -710,9 +719,17 @@ class ConsensusState:
                 self.proposal_block.hash() != maj.hash):
             if (self.proposal_block_parts is None or
                     self.proposal_block_parts.header.hash != maj.parts.hash):
-                # wait for the parts to arrive
+                # wait for the parts to arrive — and TELL peers what we
+                # hold: _new_step above broadcast before this PartSet
+                # existed, so its CommitStep was skipped; without this
+                # broadcast a catchup sender whose model says "parts
+                # already delivered" (they were dropped pre-commit) never
+                # re-sends, wedging the node until a reconnect resets the
+                # peer model (observed as the multi-process testnet
+                # rejoin stalling ~40s per height)
                 self.proposal_block = None
                 self.proposal_block_parts = PartSet(maj.parts)
+                self._broadcast_commit_step()
             return
         self._try_finalize_commit(height)
 

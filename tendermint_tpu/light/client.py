@@ -77,8 +77,12 @@ def verify_commit_any(old_set: ValidatorSet, new_set: ValidatorSet,
         raise CommitSignatureError(height, int(np.argmin(ok)))
     new_tallied = int(new_powers.sum())
     if not new_tallied * 3 > new_set.total_voting_power() * 2:
+        # foreign_votes=False: a light-client trust shortfall, not a
+        # tampered-block claim — the message must not point operators
+        # at nonexistent tampering
         raise CommitPowerError(height, new_tallied,
-                               new_set.total_voting_power())
+                               new_set.total_voting_power(),
+                               foreign_votes=False)
     old_tallied = 0
     for lane, idx in enumerate(idxs):
         if new_powers[lane] == 0:     # vote for a different block
@@ -88,7 +92,8 @@ def verify_commit_any(old_set: ValidatorSet, new_set: ValidatorSet,
             old_tallied += old_val.voting_power
     if not old_tallied * 3 > old_set.total_voting_power() * 2:
         raise CommitPowerError(height, old_tallied,
-                               old_set.total_voting_power())
+                               old_set.total_voting_power(),
+                               foreign_votes=False)
 
 
 class LightClient:

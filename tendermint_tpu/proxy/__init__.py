@@ -88,6 +88,10 @@ class ClientCreator:
         elif isinstance(self.spec, str) and self.spec.startswith("tcp://"):
             from tendermint_tpu.abci.client import new_socket_app_conns
             return new_socket_app_conns(self.spec)
+        elif isinstance(self.spec, str) and self.spec.startswith("grpc://"):
+            # ABCI over gRPC (reference proxy/client.go:75-79)
+            from tendermint_tpu.abci.grpc_app import new_grpc_app_conns
+            return new_grpc_app_conns(self.spec)
         else:
             app = create_app(self.spec)
         lock = threading.Lock()

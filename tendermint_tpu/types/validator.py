@@ -32,15 +32,23 @@ class CommitSignatureError(ValueError):
 
 
 class CommitPowerError(ValueError):
-    """A commit's tallied power for the expected block is below +2/3 —
-    either the block content was tampered (votes point at a different
-    block id) or the commit is genuinely short."""
+    """A commit's tallied power for the expected block is below +2/3.
 
-    def __init__(self, height: int, tallied: int, total: int):
+    `foreign_votes` disambiguates the two causes so fast-sync blames the
+    right deliverer: True = verified votes endorse a DIFFERENT non-nil
+    block, i.e. the block at `height` itself is not what the network
+    committed (its deliverer lied); False = every vote endorses our
+    block but too few are present — the commit (carried by the SUCCESSOR
+    block's LastCommit) was pruned, so height+1's deliverer lied."""
+
+    def __init__(self, height: int, tallied: int, total: int,
+                 foreign_votes: bool = True):
         super().__init__(
             f"insufficient voting power at height {height}: "
-            f"{tallied}/{total}")
+            f"{tallied}/{total}"
+            f"{' (votes for another block)' if foreign_votes else ''}")
         self.height = height
+        self.foreign_votes = foreign_votes
 
 
 @dataclass
@@ -275,8 +283,8 @@ class ValidatorSet:
         Derived from `commit_verify_lanes` — the per-vote validation
         lives in exactly one place — by expanding the message templates.
         """
-        templates, tmpl_idx, sigs, powers, idxs = self.commit_verify_lanes(
-            chain_id, block_id, height, commit)
+        templates, tmpl_idx, sigs, powers, idxs, _ = \
+            self.commit_verify_lanes(chain_id, block_id, height, commit)
         return (self.pubs_matrix()[idxs], templates[tmpl_idx], sigs,
                 powers, idxs)
 
@@ -289,7 +297,9 @@ class ValidatorSet:
         ship only the indices and assemble messages on device.
 
         Returns (templates[T,128], tmpl_idx[N], sigs[N,64], powers[N],
-        idxs[N]).
+        idxs[N], foreign bool) — foreign is True when any lane votes a
+        different NON-NIL block (the blame disambiguator for
+        CommitPowerError).
         """
         if self.size() != commit.size():
             raise ValueError(
@@ -301,6 +311,7 @@ class ValidatorSet:
         tmpl_of: dict[tuple, int] = {}
         templates: list[bytes] = []
         tmpl_idx, sigs, powers, idxs = [], [], [], []
+        foreign = False
         for idx, v in enumerate(commit.precommits):
             if v is None:
                 continue
@@ -325,7 +336,12 @@ class ValidatorSet:
                 templates.append(v.sign_bytes(chain_id))
             tmpl_idx.append(ti)
             sigs.append(v.signature)
-            powers.append(val.voting_power if vkey == bid_key else 0)
+            if vkey == bid_key:
+                powers.append(val.voting_power)
+            else:
+                powers.append(0)
+                if not v.block_id.is_zero():
+                    foreign = True
             idxs.append(idx)
         n = len(idxs)
         return (
@@ -335,6 +351,7 @@ class ValidatorSet:
             np.frombuffer(b"".join(sigs), np.uint8).reshape(n, 64),
             np.asarray(powers, dtype=np.int64),
             np.asarray(idxs, dtype=np.int32),
+            foreign,
         )
 
     def verify_commit(self, chain_id: str, block_id, height: int,
@@ -343,8 +360,8 @@ class ValidatorSet:
         (reference `types/validator_set.go:220-264`); signatures checked in
         one crypto-backend batch against this set's cached comb tables."""
         from tendermint_tpu.crypto import backend as cb
-        templates, tmpl_idx, sigs, powers, idxs = self.commit_verify_lanes(
-            chain_id, block_id, height, commit)
+        templates, tmpl_idx, sigs, powers, idxs, foreign = \
+            self.commit_verify_lanes(chain_id, block_id, height, commit)
         ok = cb.verify_grouped_templated(
             self.set_key(), self.pubs_matrix(), idxs, tmpl_idx,
             templates, sigs)
@@ -352,7 +369,7 @@ class ValidatorSet:
             raise CommitSignatureError(height, int(np.argmin(ok)))
         tallied = int(powers.sum())
         if not tallied * 3 > self._total * 2:
-            raise CommitPowerError(height, tallied, self._total)
+            raise CommitPowerError(height, tallied, self._total, foreign)
 
     def __str__(self):
         return (f"ValidatorSet[{self.size()} vals, "
@@ -405,7 +422,7 @@ def verify_commits_batched(val_set: ValidatorSet, chain_id: str,
             raise CommitSignatureError(h, int(np.argmin(lane_ok)))
         tallied = int(a[3].sum())
         if not tallied * 3 > total * 2:
-            raise CommitPowerError(h, tallied, total)
+            raise CommitPowerError(h, tallied, total, a[5])
 
 
 def _neg_addr(addr: bytes) -> bytes:

@@ -347,3 +347,85 @@ def test_pool_rate_eviction_spares_first_block(monkeypatch):
     time.sleep(0.2)
     pool.schedule()
     assert evicted == ["fresh"]
+
+
+def test_commit_power_error_blame_disambiguation():
+    """Unit: CommitPowerError.foreign_votes separates 'block h tampered'
+    (votes endorse another block) from 'commit pruned by successor'
+    (votes endorse ours, too few present)."""
+    privs, vs = make_validators(4)
+    chain = build_chain(privs, vs, CHAIN, 2, txs_per_block=1)
+    from tendermint_tpu.types import BlockID
+    from tendermint_tpu.types.validator import CommitPowerError
+    block, ps, seen = chain[0]
+    bid = BlockID(block.hash(), ps.header)
+    # pruned: drop half the votes -> short power, all remaining endorse us
+    pruned = type(seen)(block_id=seen.block_id,
+                        precommits=[seen.precommits[0], None,
+                                    seen.precommits[2], None])
+    with pytest.raises(CommitPowerError) as ei:
+        vs.verify_commit(CHAIN, bid, 1, pruned)
+    assert ei.value.foreign_votes is False
+    # foreign: verify against a DIFFERENT block id -> valid votes endorse
+    # "another" block
+    other = BlockID(b"\x77" * 32, ps.header)
+    with pytest.raises(CommitPowerError) as ei:
+        vs.verify_commit(CHAIN, other, 1, seen)
+    assert ei.value.foreign_votes is True
+
+
+@pytest.mark.slow
+def test_fast_sync_byzantine_pruned_commit_spares_honest_peer():
+    """A byzantine peer serving blocks whose LastCommit was pruned below
+    +2/3 must be evicted — and the HONEST peer that delivered the
+    preceding block must not be (reference blame model: the commit for
+    height h rides in block h+1, `blockchain/reactor.go:232-236`)."""
+    privs, vs = make_validators(4)
+    gen = make_genesis(CHAIN, privs)
+    hashes = kvstore_app_hashes(N_BLOCKS)
+    chain = build_chain(privs, vs, CHAIN, N_BLOCKS, app_hashes=hashes)
+
+    byz_sw, _, byz_store = _source_node(chain, gen)
+    byz_reactor = byz_sw.reactor("blockchain")
+    orig_receive = byz_reactor.receive
+
+    def pruning_receive(ch_id, peer, raw):
+        msg = BM.decode_msg(raw)
+        if isinstance(msg, BM.BlockRequest) and msg.height > 1:
+            from tendermint_tpu.types.block import Block
+            block = byz_store.load_block(msg.height)
+            lc = block.last_commit
+            keep = [v if i == 0 else None
+                    for i, v in enumerate(lc.precommits)]   # 1/4 power
+            evil = Block(header=block.header, txs=block.txs,
+                         last_commit=type(lc)(block_id=lc.block_id,
+                                              precommits=keep))
+            peer.try_send(BLOCKCHAIN_CHANNEL, BM.encode_msg(
+                BM.BlockResponse(evil.encode())))
+            return
+        orig_receive(ch_id, peer, raw)
+
+    byz_reactor.receive = pruning_receive
+    honest_sw, _, honest_store = _source_node(chain, gen)
+    sync_sw, bc, cons, sync_store = _sync_node(gen, batch_size=4)
+    evicted = []
+    bc.pool.on_evict = lambda p, r: evicted.append(p)
+    for sw in (byz_sw, honest_sw, sync_sw):
+        sw.start()
+    try:
+        connect_switches(sync_sw, byz_sw)
+        connect_switches(sync_sw, honest_sw)
+        honest_id = honest_sw.node_info.id
+        byz_id = byz_sw.node_info.id
+        deadline = time.time() + 40
+        while sync_store.height < N_BLOCKS - 1 and time.time() < deadline:
+            time.sleep(0.02)
+        assert sync_store.height >= N_BLOCKS - 1, \
+            f"synced only to {sync_store.height}: {bc.pool.status()}"
+        assert honest_id not in evicted, "honest peer was evicted"
+        for h in range(1, N_BLOCKS - 1):
+            assert sync_store.load_block(h).hash() == \
+                honest_store.load_block(h).hash()
+    finally:
+        for sw in (byz_sw, honest_sw, sync_sw):
+            sw.stop()
