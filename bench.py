@@ -320,11 +320,14 @@ def _replay_chain(n_vals: int, n_blocks: int, backend: str,
     total_power = vals.total_voting_power()
 
     def _prep(blocks):
-        """Stage 1: part-set re-hash + lane assembly (host).  Lanes are
-        the TEMPLATED form: ~1 message template per block plus per-lane
-        (sig, validator index, template index) — the device assembles
-        messages and gathers pubkeys itself, so the host ships 72 B/lane
-        instead of 228 B."""
+        """Stage 1: part-set re-hash + lane assembly (host).  Hashing
+        stays HOST-side here deliberately: the verify stage saturates the
+        single device, so moving the part re-hash onto it (as tried with
+        `from_data_batched`) serializes the pipeline and loses ~25%
+        end-to-end.  Lanes are the TEMPLATED form: ~1 message template
+        per block plus per-lane (sig, validator index, template index) —
+        the device assembles messages and gathers pubkeys itself, so the
+        host ships 72 B/lane instead of 228 B."""
         items, lanes = [], []
         for block, _, seen in blocks:
             parts = block.make_part_set()       # re-hash like fast-sync
@@ -501,11 +504,17 @@ def config4_light_multichain(quick: bool) -> dict:
     log("[config4] warm-up (8 table sets + chunk-shape compiles)...")
     t0 = time.perf_counter()
     for set_key, val_pubs, templates, sigs in chains:
+        # warm on TAMPERED inputs: the dev-tunnel result-caches
+        # byte-identical calls, so re-running chunk 0 pristine in the
+        # timed loop would be measured as nearly free (and the rejected
+        # lane doubles as a correctness probe)
+        warm_sigs = sigs[:chunk_h * V].copy()
+        warm_sigs[0, 0] ^= 0xFF
         ok = backend.verify_grouped_templated(
             set_key, val_pubs, idx_chunk, tmpl_idx_chunk,
-            templates[:chunk_h], sigs[:chunk_h * V])
-        if not ok.all():
-            raise RuntimeError("light verify failed in warm-up")
+            templates[:chunk_h], warm_sigs)
+        if ok[0] or not ok[1:].all():
+            raise RuntimeError("light verify warm-up mismatch")
     first = time.perf_counter() - t0
     # steady state: stream every (chain, chunk) with depth-2 dispatch
     t0 = time.perf_counter()

@@ -80,9 +80,10 @@ class TpuBackend:
     """
     name = "tpu"
 
-    # Cached comb tables are ~0.8 MB per validator (uint8) — 8 sets of
-    # 128 validators is ~0.8 GB of HBM; plenty for a node following one
-    # chain plus a light client tracking a handful of others.
+    # Cached 10-bit comb tables are ~2.5 MB per validator (uint8): 8
+    # full sets of 128 validators is ~2.6 GB of a 16 GB chip's HBM —
+    # sized for a node following one chain plus a light client tracking
+    # a handful of others; raise with care.
     TABLE_CACHE_SETS = 8
 
     def __init__(self):

@@ -211,82 +211,112 @@ def scalar_mul(s: jnp.ndarray, Q) -> tuple:
     return acc
 
 
-def build_comb_tables(Q) -> tuple:
-    """Per-point fixed-base comb tables, built ON DEVICE.
+COMB_WBITS = 10                       # per-validator comb window width
+COMB_WINDOWS = -(-256 // COMB_WBITS)  # 26 windows cover 256 bits
+COMB_DIGITS = 1 << COMB_WBITS
 
-    Q: point with coords [..., V, 32] (V points, e.g. one per validator).
-    Returns per-coordinate arrays [32, 256, ..., V, 32]:
-    entry[w, j] = j * 2^(8w) * Q — so [k]Q needs just 32 table adds and
-    ZERO doublings (the structure of `scalar_mul_base`, generalized to
-    runtime points).
 
-    Why: fast-sync verifies thousands of commits against the SAME
-    validator set; amortizing the ~2.7k field muls of a cold variable-base
-    ladder into a cached table leaves ~0.3k muls per signature.  Build is
-    one device call: a 256-step add scan for window 0, then 8 parallel
-    doublings per window — sequential depth ~500 point ops over wide
-    [256 x V] batches.
-    """
+def _comb_row0(Q) -> tuple:
+    """Window-0 digit rows j*Q for j in [0, 1024): a 256-step add scan
+    builds digits < 256, then three WIDE adds of 256Q/512Q/768Q extend to
+    1024 (not a 1024-step scan).  Coords [1024, ..., V, 32] per coord."""
     def add_step(acc, _):
         nxt = pt_add(acc, Q)
         return nxt, acc
-    _, row0 = lax.scan(add_step, identity(Q[0].shape[:-1]), None,
-                       length=256)
-    # row0 coords: [256, ..., V, 32]
-
-    def window_step(row, _):
-        nxt = row
-        for _ in range(8):              # x256 = shift one 8-bit window up
-            nxt = pt_dbl(nxt)
-        return nxt, row
-
-    _, rows = lax.scan(window_step, row0, None, length=32)
-    return rows                          # [32, 256, ..., V, 32] per coord
+    p256, row_lo = lax.scan(add_step, identity(Q[0].shape[:-1]), None,
+                            length=256)
+    quarters = [row_lo]
+    for _ in range(3):                  # j0 + 256, j0 + 512, j0 + 768
+        quarters.append(pt_add(quarters[-1], tuple(
+            jnp.broadcast_to(c, q.shape)
+            for c, q in zip(p256, quarters[-1]))))
+    return tuple(jnp.concatenate([q[i] for q in quarters], axis=0)
+                 for i in range(4))
 
 
-def comb_to_affine(tbl) -> tuple:
-    """Extended comb tables -> packed affine tables, ON DEVICE.
+def build_affine_comb(Q) -> tuple:
+    """Per-point 10-bit comb tables, built ON DEVICE as packed affine.
 
-    tbl: `build_comb_tables` output, coords [32, 256, V, 32].
-    Returns (packed uint8[32, 256, V, 3, 32], ok bool[V]) where entry
-    [w, j, v] = (y+x, y-x, 2d*x*y) of j * 2^(8w) * Q_v in canonical
-    bytes — uint8 storage quarters the gather traffic of the hot loop
-    and mixed addition (`pt_add_affine`, 7 muls) replaces extended
-    addition (9 muls).  One Montgomery batch inversion normalizes all
-    32*256*V entries at once.  Identity entries (Z=1, X=0, Y=1) become
-    (1, 1, 0) — exactly `pt_add_affine`'s no-op entry, so digit 0 needs
-    no special case.  ok[v] is False if any entry of validator v failed
-    to normalize (garbage chains from an invalid input point).
+    Q: point with coords [..., V, 32] (V points, e.g. one per validator).
+    Returns (packed uint8[26, 1024, V, 3, 32], ok bool[V]) where entry
+    [w, j, v] = (y+x, y-x, 2d*x*y) of j * 2^(10w) * Q_v in canonical
+    bytes — so [k]Q needs 26 gathered mixed adds (`pt_add_affine`,
+    7 muls) and ZERO doublings; uint8 storage quarters the hot loop's
+    gather traffic, and the (1, 1, 0) identity entries make digit 0 a
+    no-op.  10-bit windows trade 4x table memory for 6 fewer adds per
+    lane vs an 8-bit comb.
+
+    Why fused: per window the extended row converts to affine bytes
+    INSIDE the scan body (one Montgomery batch inversion per window), so
+    only the uint8 output and one extended row ever live on device — a
+    two-phase build materializes all 26 windows in int32 extended
+    coordinates (~1.7 GB at V=128) plus inversion temporaries, which
+    OOMs a 16 GB chip.  Sequential depth ~530 point ops; fast-sync then
+    amortizes the build over thousands of commits against the same set.
     """
-    x, y, z, _ = tbl
-    shape = z.shape                                  # [32, 256, V, 32]
+    def window_step(row, _):
+        packed, ok = _affine_pack(row)
+        nxt = row
+        for _ in range(COMB_WBITS):     # x1024 = shift one window up
+            nxt = pt_dbl(nxt)
+        return nxt, (packed, ok)
+
+    _, (tbl, oks) = lax.scan(window_step, _comb_row0(Q), None,
+                             length=COMB_WINDOWS)
+    return tbl, jnp.all(oks, axis=(0, 1))
+
+
+def _affine_pack(row) -> tuple:
+    """One window's extended coords [1024, ..., V, 32] -> packed affine
+    uint8[1024, ..., V, 3, 32] + per-entry nonzero mask.  One batch
+    inversion normalizes the whole window; Z == 0 lanes (garbage chains
+    from an invalid input point) are flagged False."""
+    x, y, z, _ = row
+    shape = z.shape
     zi, nz = fe.batch_inv(z.reshape(-1, fe.NLIMBS))
     zi = zi.reshape(shape)
     xa, ya = fe.mul(x, zi), fe.mul(y, zi)
-    rows = jnp.stack([
+    packed = jnp.stack([
         fe.to_bytes(fe.add(ya, xa)),
         fe.to_bytes(fe.sub(ya, xa)),
         fe.to_bytes(fe.mul(fe.mul(xa, ya), jnp.asarray(_D2))),
-    ], axis=-2)                                      # [32, 256, V, 3, 32]
-    ok = jnp.all(nz.reshape(shape[:-1]), axis=(0, 1))
-    return rows, ok
+    ], axis=-2)
+    return packed, nz.reshape(shape[:-1])
+
+
+# Static layout for 10-bit digit extraction: window w covers bits
+# [10w, 10w+10) — always two bytes (offset 0/2/4/6); the top window has
+# only 6 real bits (masked hi byte).
+_D10_LO = np.array([(COMB_WBITS * w) // 8 for w in range(COMB_WINDOWS)])
+_D10_SH = np.array([(COMB_WBITS * w) % 8 for w in range(COMB_WINDOWS)])
+_D10_HI = np.minimum(_D10_LO + 1, fe.NLIMBS - 1)
+_D10_HI_OK = (_D10_LO + 1 <= fe.NLIMBS - 1).astype(np.int32)
+
+
+def digits10(s: jnp.ndarray) -> jnp.ndarray:
+    """Bytes/limbs [..., 32] -> 26 little-endian 10-bit digits [..., 26]."""
+    x = s.astype(jnp.int32)
+    lo = jnp.take(x, jnp.asarray(_D10_LO), axis=-1)
+    hi = jnp.take(x, jnp.asarray(_D10_HI), axis=-1) * jnp.asarray(_D10_HI_OK)
+    sh = jnp.asarray(_D10_SH)
+    return ((lo >> sh) | (hi << (8 - sh))) & (COMB_DIGITS - 1)
 
 
 def scalar_mul_comb(tbl: jnp.ndarray, val_idx: jnp.ndarray,
                     s: jnp.ndarray) -> tuple:
     """[s] * Q_{val_idx} from packed affine comb tables.
 
-    tbl: `comb_to_affine` output uint8[32, 256, V, 3, 32];
+    tbl: `comb_to_affine` output uint8[26, 1024, V, 3, 32];
     val_idx int32 [N]; s bytes/limbs [N, 32] -> point coords [N, 32].
-    32 gathered mixed adds, no doublings: ~224 field muls per lane vs
+    26 gathered mixed adds, no doublings: ~182 field muls per lane vs
     ~2760 for the cold variable-base ladder in `scalar_mul`.
     """
     V = tbl.shape[2]
-    digits = jnp.moveaxis(s.astype(jnp.int32), -1, 0)   # [32, N]
+    digits = jnp.moveaxis(digits10(s), -1, 0)           # [26, N]
 
     def body(acc, xs):
-        digit, tw = xs                   # tw: [256, V, 3, 32] uint8
-        flat = tw.reshape(256 * V, 3, fe.NLIMBS)
+        digit, tw = xs                   # tw: [1024, V, 3, 32] uint8
+        flat = tw.reshape(COMB_DIGITS * V, 3, fe.NLIMBS)
         sel = jnp.take(flat, digit * V + val_idx, axis=0).astype(jnp.int32)
         aff = (sel[..., 0, :], sel[..., 1, :], sel[..., 2, :])
         return pt_add_affine(acc, aff), None

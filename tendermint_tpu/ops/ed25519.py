@@ -60,7 +60,7 @@ verify_batch = jax.jit(verify)
 def build_neg_comb(pubkeys: jnp.ndarray) -> tuple:
     """Decompress V pubkeys and build packed affine comb tables of THEIR
     NEGATIONS (verification needs [k](-A)).
-    Returns (table uint8[32, 256, V, 3, 32], ok bool[V]).
+    Returns (table uint8[26, 1024, V, 3, 32], ok bool[V]).
 
     One device call per validator set; the tables then serve every
     subsequent verify against that set (see `crypto.backend`'s cache).
@@ -68,8 +68,7 @@ def build_neg_comb(pubkeys: jnp.ndarray) -> tuple:
     loop re-does the full ladder per vote (`types/validator_set.go:247`).
     """
     A, ok = curve.decompress(pubkeys)
-    tbl, tbl_ok = curve.comb_to_affine(
-        curve.build_comb_tables(curve.pt_neg(A)))
+    tbl, tbl_ok = curve.build_affine_comb(curve.pt_neg(A))
     return tbl, ok & tbl_ok
 
 
