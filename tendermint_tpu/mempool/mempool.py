@@ -35,6 +35,27 @@ class Mempool:
         self._wal_path = wal_path
         self._wal = open(wal_path, "ab") if wal_path else None
         self._recovering = False
+        self._notify_cbs: list = []   # gossip wakeups on pool change
+        self._tx_heights: dict[bytes, int] = {}   # hash -> admission height
+
+    def add_notify_cb(self, cb) -> None:
+        """Register a zero-arg callback fired whenever the pool gains a
+        tx (event-driven gossip instead of polling)."""
+        self._notify_cbs.append(cb)
+
+    def remove_notify_cb(self, cb) -> None:
+        """Deregister (reactor shutdown must not leak dead callbacks)."""
+        try:
+            self._notify_cbs.remove(cb)
+        except ValueError:
+            pass
+
+    def _fire_notify(self) -> None:
+        for cb in self._notify_cbs:
+            try:
+                cb()
+            except Exception:
+                pass
 
     # -- locking across app Commit (reference state/execution.go:248) ----
     def lock(self):
@@ -66,7 +87,12 @@ class Mempool:
                     self._wal.write(len(tx).to_bytes(4, "big") + tx)
                     self._wal.flush()
                 self._txs[h] = tx
+                # reference memTx.Height: the height the tx was validated
+                # at — the gossip height-gate keys on THIS, not the pool's
+                # moving height (old txs must not be re-gated forever)
+                self._tx_heights[h] = self._height + 1
                 self._notify_available()
+                self._fire_notify()
             else:
                 # invalid tx: allow future resubmission (reference :259-264)
                 self._cache.pop(h, None)
@@ -153,6 +179,14 @@ class Mempool:
         with self._lock:
             return list(self._txs.values())[n:]
 
+    def txs_with_heights(self) -> list[tuple[bytes, bytes, int]]:
+        """Gossip helper: (hash, tx, admission height) triples in pool
+        order — the hash rides along so broadcast sweeps need not
+        recompute it per tx per peer."""
+        with self._lock:
+            return [(h, tx, self._tx_heights.get(h, 0))
+                    for h, tx in self._txs.items()]
+
     # -- post-commit -----------------------------------------------------
     def update(self, height: int, committed_txs: list[bytes]) -> None:
         """Drop committed txs, recheck the rest (reference `:329-391`).
@@ -162,12 +196,15 @@ class Mempool:
         for tx in committed_txs:
             h = Tx(tx).hash
             self._txs.pop(h, None)
+            self._tx_heights.pop(h, None)
             self._cache[h] = None   # committed: permanently deduped
         if self.recheck_enabled and self._txs:
             survivors = OrderedDict()
             for h, tx in self._txs.items():
                 if self.proxy.check_tx(tx).is_ok:
                     survivors[h] = tx
+                else:
+                    self._tx_heights.pop(h, None)
             self._txs = survivors
         # compact the journal to the surviving pool: committed txs must
         # not be re-admitted (and re-EXECUTED) by a later recover_wal
@@ -196,6 +233,7 @@ class Mempool:
     def flush(self) -> None:
         with self._lock:
             self._txs.clear()
+            self._tx_heights.clear()
             self._cache.clear()
             self._rewrite_wal()   # journal == pool, or recovery resurrects
 

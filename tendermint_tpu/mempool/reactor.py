@@ -8,17 +8,15 @@ Reference: `mempool/reactor.go` — channel 0x30 (`:19`); a per-peer
 from __future__ import annotations
 
 import threading
-import time
 
 from tendermint_tpu.p2p.peer import Peer, Reactor
 from tendermint_tpu.p2p.types import ChannelDescriptor
-from tendermint_tpu.types.tx import Tx
 from tendermint_tpu.utils.log import get_logger
 
 log = get_logger("mempool")
 
 MEMPOOL_CHANNEL = 0x30
-BROADCAST_SLEEP = 0.02
+BROADCAST_SLEEP = 0.1    # idle-only safety net; gossip is event-driven
 
 
 class MempoolReactor(Reactor):
@@ -28,6 +26,23 @@ class MempoolReactor(Reactor):
         self.broadcast = broadcast
         self._peer_stops: dict[str, threading.Event] = {}
         self._lock = threading.Lock()
+        # event-driven gossip (same shape as the consensus reactor): a
+        # new local/gossiped tx bumps the sequence and wakes the
+        # broadcast routines; idle routines block instead of busy-polling
+        self._wake = threading.Condition()
+        self._wake_seq = 0
+        if hasattr(mempool, "add_notify_cb"):
+            mempool.add_notify_cb(self._notify_work)
+
+    def _notify_work(self) -> None:
+        with self._wake:
+            self._wake_seq += 1
+            self._wake.notify_all()
+
+    def _wait_work(self, seen_seq: int, timeout: float) -> None:
+        with self._wake:
+            if self._wake_seq == seen_seq:
+                self._wake.wait(timeout)
 
     def get_channels(self):
         return [ChannelDescriptor(id=MEMPOOL_CHANNEL, priority=5,
@@ -48,11 +63,15 @@ class MempoolReactor(Reactor):
             stop = self._peer_stops.pop(peer.id, None)
         if stop is not None:
             stop.set()
+        self._notify_work()
 
     def stop(self) -> None:
         with self._lock:
             for ev in self._peer_stops.values():
                 ev.set()
+        if hasattr(self.mempool, "remove_notify_cb"):
+            self.mempool.remove_notify_cb(self._notify_work)
+        self._notify_work()
 
     def receive(self, ch_id: int, peer: Peer, msg: bytes) -> None:
         """A gossiped tx enters through CheckTx exactly like RPC
@@ -71,31 +90,32 @@ class MempoolReactor(Reactor):
         sent: set[bytes] = set()
         while not stop.is_set():
             try:
-                # height-gating (reference `:111+` waits on peer height):
-                # a peer still fast-syncing (its consensus height more
-                # than one block behind the pool's) would only discard
-                # tx pushes — hold gossip until it is nearly caught up
+                seq = self._wake_seq
+                # height-gating (reference `:111+` waits on peer height,
+                # PER TX against its admission height): a peer still
+                # fast-syncing would only discard pushes of txs admitted
+                # far ahead of it — but gating on the pool's moving
+                # height would starve old txs whenever the peer's
+                # advertised height lags a block, so the reference allows
+                # one-behind per tx
                 ps = peer.get("consensus")
-                if ps is not None:
-                    pool_h = self.mempool.height()
-                    if pool_h > 0 and ps.prs.height < pool_h:
-                        stop.wait(BROADCAST_SLEEP * 5)
-                        continue
-                txs = self.mempool.txs_after(0)
+                peer_h = ps.prs.height if ps is not None else None
+                pairs = self.mempool.txs_with_heights()
                 live = set()
                 pushed = False
-                for tx in txs:
-                    h = Tx(tx).hash
+                for h, tx, admit_h in pairs:
                     live.add(h)
                     if h in sent:
                         continue
+                    if peer_h is not None and peer_h < admit_h - 1:
+                        continue     # peer too far behind for this tx
                     if peer.send(MEMPOOL_CHANNEL, tx, timeout=5.0):
                         sent.add(h)
                         pushed = True
                 # prune hashes no longer in the pool (committed/evicted)
                 sent &= live
                 if not pushed:
-                    time.sleep(BROADCAST_SLEEP)
+                    self._wait_work(seq, BROADCAST_SLEEP)
             except Exception:
                 log.exception("tx broadcast failed", peer=peer.id[:8])
-                time.sleep(BROADCAST_SLEEP)
+                stop.wait(BROADCAST_SLEEP)

@@ -306,7 +306,7 @@ def scalar_mul_comb(tbl: jnp.ndarray, val_idx: jnp.ndarray,
                     s: jnp.ndarray) -> tuple:
     """[s] * Q_{val_idx} from packed affine comb tables.
 
-    tbl: `comb_to_affine` output uint8[26, 1024, V, 3, 32];
+    tbl: `build_affine_comb` output uint8[26, 1024, V, 3, 32];
     val_idx int32 [N]; s bytes/limbs [N, 32] -> point coords [N, 32].
     26 gathered mixed adds, no doublings: ~182 field muls per lane vs
     ~2760 for the cold variable-base ladder in `scalar_mul`.
