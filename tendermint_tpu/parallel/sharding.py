@@ -108,7 +108,7 @@ def sharded_grouped_verify_fn(mesh: Mesh, axis: str = "batch"):
 
     The table for a validator set is identical on every chip (the fixed
     keys), so only the (val_idx, pubkeys, msgs, sigs) lanes split across
-    the mesh — each chip runs the 32-add comb path on its shard with NO
+    the mesh — each chip runs the 26-add comb path on its shard with NO
     collectives in the hot loop (the bool gather at the end rides ICI).
     Tables arrive as ARGUMENTS (already replicated/committed at build
     time by the backend) so one jitted fn per shape serves every
