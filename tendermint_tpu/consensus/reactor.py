@@ -362,7 +362,14 @@ class ConsensusReactor(Reactor):
     def _receive(self, ch_id: int, peer: Peer, ps: "PeerState", msg) -> None:
         if ch_id == STATE_CHANNEL:
             if isinstance(msg, M.NewRoundStepMessage):
+                advanced = msg.height > ps.prs.height
                 ps.apply_new_round_step(msg)
+                if advanced and self.switch is not None:
+                    # peer height moved: height-gated mempool gossip may
+                    # now have sendable txs for it
+                    mp = self.switch.reactor("mempool")
+                    if mp is not None and hasattr(mp, "wake"):
+                        mp.wake()
             elif isinstance(msg, M.CommitStepMessage):
                 ps.apply_commit_step(msg)
             elif isinstance(msg, M.HasVoteMessage):
@@ -371,22 +378,50 @@ class ConsensusReactor(Reactor):
                 self._on_vote_set_maj23(peer, ps, msg)
             elif isinstance(msg, M.ProposalHeartbeatMessage):
                 hb = msg.heartbeat
-                # observability only (reference :214-218 logs it)
-                log.debug("proposal heartbeat", peer=peer.id[:8],
-                          height=hb.height, round=hb.round,
-                          seq=hb.sequence)
+                # observability only (reference :214-218 logs it) — but
+                # authenticate before attributing: any peer could spoof a
+                # heartbeat naming another validator.  Gated on the debug
+                # level: the verify (pure-Python fallback ~10ms) must not
+                # become a receive-thread stall amplifier feeding a log
+                # line that default levels discard.
+                from tendermint_tpu.utils.log import DEBUG
+                if log.enabled(DEBUG):
+                    rs = self.cs.get_round_state()
+                    val = (rs.validators.get_by_address(hb.validator_address)
+                           if rs.validators is not None else None)
+                    authentic = (val is not None and val.pub_key.verify(
+                        hb.sign_bytes(self.cs.state.chain_id), hb.signature))
+                    log.debug("proposal heartbeat", peer=peer.id[:8],
+                              height=hb.height, round=hb.round,
+                              seq=hb.sequence, authentic=authentic)
         elif ch_id == DATA_CHANNEL:
             if self.fast_sync:
                 return
             if isinstance(msg, M.ProposalMessage):
                 ps.set_has_proposal(msg.proposal)
-                self.cs.set_proposal(msg.proposal, peer.id)
+                # dedup prefilter: N peers each relay the round's proposal,
+                # and the serialized core would drop the copies anyway
+                # (`_set_proposal` keeps the first) — skipping them here
+                # keeps redundant work off the single consensus thread.
+                # Safe against the queue's async lag: once a proposal for
+                # (h, r) is set, a second one only becomes acceptable
+                # after a round/height change, which also invalidates it.
+                rs = self.cs.get_round_state()
+                p = msg.proposal
+                if not (rs.proposal is not None and rs.height == p.height
+                        and rs.round == p.round):
+                    self.cs.set_proposal(p, peer.id)
             elif isinstance(msg, M.ProposalPOLMessage):
                 ps.apply_proposal_pol(msg)
             elif isinstance(msg, M.BlockPartMessage):
                 ps.set_has_part(msg.height, msg.part.index)
-                self.cs.add_proposal_block_part(msg.height, msg.round,
-                                                msg.part, peer.id)
+                rs = self.cs.get_round_state()
+                parts = rs.proposal_block_parts
+                if not (rs.height == msg.height and parts is not None and
+                        0 <= msg.part.index < parts.total and
+                        parts.has_part(msg.part.index)):
+                    self.cs.add_proposal_block_part(msg.height, msg.round,
+                                                    msg.part, peer.id)
         elif ch_id == VOTE_CHANNEL:
             if self.fast_sync:
                 return
@@ -396,10 +431,34 @@ class ConsensusReactor(Reactor):
                 n = rs.validators.size() if rs.validators else None
                 ps.set_has_vote(v.height, v.round, v.type,
                                 v.validator_index, n)
-                self.cs.add_vote(v, peer.id)
+                if not self._core_has_vote(rs, v):
+                    self.cs.add_vote(v, peer.id)
         elif ch_id == VOTE_SET_BITS_CHANNEL:
             if isinstance(msg, M.VoteSetBitsMessage):
                 ps.apply_vote_set_bits(msg, None)
+
+    @staticmethod
+    def _core_has_vote(rs, v) -> bool:
+        """Dedup prefilter: True iff the core already holds EXACTLY this
+        vote (same block, same signature).  Conflicting votes (different
+        block for the same slot) must still go through — they are
+        equivocation evidence.  A stale False only costs one queue item
+        the core drops itself, so races are harmless."""
+        if v.height == rs.height and rs.votes is not None:
+            vs = (rs.votes.prevotes(v.round) if v.type == TYPE_PREVOTE
+                  else rs.votes.precommits(v.round))
+        elif (v.height + 1 == rs.height and rs.last_commit is not None
+              and v.type == TYPE_PRECOMMIT
+              and v.round == rs.last_commit.round):
+            vs = rs.last_commit
+        else:
+            return False
+        if vs is None or not (0 <= v.validator_index < vs.size()):
+            return False
+        ex = vs.get_by_index(v.validator_index)
+        return (ex is not None and
+                ex.block_id.key() == v.block_id.key() and
+                ex.signature == v.signature)
 
     def _on_vote_set_maj23(self, peer: Peer, ps: PeerState,
                            msg: M.VoteSetMaj23Message) -> None:

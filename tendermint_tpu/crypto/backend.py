@@ -96,6 +96,8 @@ class TpuBackend:
         self._dev = dev
         # set_key -> (tbl, ok, V, staged key matrix)
         self._tables: dict[bytes, tuple] = {}
+        # seed-set hash -> staged (a, prefix, pubkey) sign matrices
+        self._sign_keys: dict[bytes, tuple] = {}
         self._tables_lock = threading.Lock()
         self._builds: dict[bytes, threading.Event] = {}  # in-flight builds
         # multi-chip: shard verify lanes over every visible device (comb
@@ -123,7 +125,12 @@ class TpuBackend:
         out = self._dev.verify_batch(jnp.asarray(pubkeys), jnp.asarray(msgs),
                                      jnp.asarray(sigs))
         out = np.asarray(out)
-        REGISTRY.device_step_seconds.observe(time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        # sync call: dispatch and wait are one interval — record it under
+        # both summaries so they stay comparable with the async path
+        # (which records the wait alone in step, full wall in dispatch)
+        REGISTRY.device_step_seconds.observe(dt)
+        REGISTRY.device_dispatch_seconds.observe(dt)
         REGISTRY.sigs_requested.inc(n)
         REGISTRY.sigs_verified.inc(int(out[:n].sum()))
         REGISTRY.verify_batches.inc()
@@ -234,8 +241,16 @@ class TpuBackend:
             jnp.asarray(templates), jnp.asarray(sigs))
 
         def collect() -> np.ndarray:
+            # time only the wait-for-result here: a pipelined caller does
+            # host work for window k+1 between dispatch and collect, and
+            # folding that overlap into the histogram would skew the
+            # device-step metric upward (dispatch-to-collect wall is the
+            # caller's pipeline depth, not the device's step time)
+            t1 = time.perf_counter()
             out = np.asarray(dev_out)
-            REGISTRY.device_step_seconds.observe(time.perf_counter() - t0)
+            now = time.perf_counter()
+            REGISTRY.device_step_seconds.observe(now - t1)
+            REGISTRY.device_dispatch_seconds.observe(now - t0)
             REGISTRY.sigs_requested.inc(n)
             REGISTRY.sigs_verified.inc(int(out[:n].sum()))
             REGISTRY.verify_batches.inc()
@@ -243,6 +258,62 @@ class TpuBackend:
             return out[:n]
 
         return collect
+
+    def sign_grouped_templated(self, seeds, val_idx, tmpl_idx,
+                               templates) -> np.ndarray:
+        """Batched signing against a fixed seed set: lane i signs
+        templates[tmpl_idx[i]] with key seeds[val_idx[i]].  The device
+        runs the full RFC 8032 pipeline (`ops.ed25519
+        .sign_grouped_templated`); the host only derives each seed's
+        (clamped scalar, prefix, pubkey) triple once.  Bulk fixture and
+        testnet signing — the reference signs one vote at a time
+        (`types/priv_validator.go` SignVote)."""
+        import hashlib
+        n = len(val_idx)
+        if n == 0:
+            return np.zeros((0, 64), dtype=np.uint8)
+        key = hashlib.sha256(b"".join(bytes(s) for s in seeds)).digest()
+        with self._tables_lock:
+            ent = self._sign_keys.get(key)
+        if ent is None:
+            from tendermint_tpu.crypto import pure_ed25519 as _ref
+            v = len(seeds)
+            a = np.zeros((v, 32), np.uint8)
+            pre = np.zeros((v, 32), np.uint8)
+            pubs = np.zeros((v, 32), np.uint8)
+            for i, seed in enumerate(seeds):
+                ai, pi, pubi = _ref.expand_seed(bytes(seed))
+                a[i] = np.frombuffer(ai, np.uint8)
+                pre[i] = np.frombuffer(pi, np.uint8)
+                pubs[i] = np.frombuffer(pubi, np.uint8)
+            ent = tuple(self._jnp.asarray(x) for x in (a, pre, pubs))
+            with self._tables_lock:
+                # bounded like the comb-table cache: each entry pins three
+                # small device arrays, but rotating fixture sets must not
+                # accumulate forever
+                while len(self._sign_keys) >= self.TABLE_CACHE_SETS:
+                    self._sign_keys.pop(next(iter(self._sign_keys)))
+                self._sign_keys.setdefault(key, ent)
+                ent = self._sign_keys[key]
+        a_dev, pre_dev, pubs_dev = ent
+        b = _bucket(n)
+        val_idx = np.asarray(val_idx, dtype=np.int32)
+        tmpl_idx = np.asarray(tmpl_idx, dtype=np.int32)
+        if b > n:
+            val_idx = np.concatenate([val_idx, np.repeat(val_idx[:1], b - n)])
+            tmpl_idx = np.concatenate([tmpl_idx,
+                                       np.repeat(tmpl_idx[:1], b - n)])
+        t = len(templates)
+        tb = _bucket(t)
+        if tb > t:
+            templates = np.concatenate(
+                [templates,
+                 np.zeros((tb - t, templates.shape[1]), np.uint8)])
+        jnp = self._jnp
+        out = np.asarray(self._dev.sign_grouped_templated_jit(
+            a_dev, pre_dev, pubs_dev, jnp.asarray(val_idx),
+            jnp.asarray(tmpl_idx), jnp.asarray(templates)))
+        return out[:n]
 
     def precompile(self, set_key: bytes, val_pubs: np.ndarray,
                    shapes: list[tuple[int, int]], msg_len: int) -> None:
@@ -322,7 +393,9 @@ class TpuBackend:
                 tbl, pub_ok, jnp.asarray(val_idx.astype(np.int32)),
                 jnp.asarray(pubkeys), jnp.asarray(msgs), jnp.asarray(sigs))
         out = np.asarray(out)
-        REGISTRY.device_step_seconds.observe(time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        REGISTRY.device_step_seconds.observe(dt)      # sync: step ==
+        REGISTRY.device_dispatch_seconds.observe(dt)  # dispatch interval
         REGISTRY.sigs_requested.inc(n)
         REGISTRY.sigs_verified.inc(int(out[:n].sum()))
         REGISTRY.verify_batches.inc()

@@ -39,6 +39,13 @@ class MempoolReactor(Reactor):
             self._wake_seq += 1
             self._wake.notify_all()
 
+    def wake(self) -> None:
+        """Cross-reactor nudge: the consensus reactor calls this when a
+        peer's advertised height advances, so height-gated txs retry
+        immediately instead of waiting out BROADCAST_SLEEP (the safety
+        net would mask the coupling if the sleep were ever raised)."""
+        self._notify_work()
+
     def _wait_work(self, seen_seq: int, timeout: float) -> None:
         with self._wake:
             if self._wake_seq == seen_seq:

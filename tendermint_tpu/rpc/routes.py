@@ -104,7 +104,10 @@ class Routes:
                 "last_block_app_hash": _hexb(info.last_block_app_hash)}
 
     def abci_query(self, params: dict) -> dict:
-        data = bytes.fromhex(params.get("data", ""))
+        data = params.get("data", "")
+        if data.startswith("0x"):       # same prefix tolerance as the tx
+            data = data[2:]             # routes (reference accepts both)
+        data = bytes.fromhex(data)
         path = params.get("path", "/")
         height = int(params.get("height", 0))
         prove = bool(params.get("prove", False))

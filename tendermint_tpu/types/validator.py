@@ -297,9 +297,11 @@ class ValidatorSet:
         ship only the indices and assemble messages on device.
 
         Returns (templates[T,128], tmpl_idx[N], sigs[N,64], powers[N],
-        idxs[N], foreign bool) — foreign is True when any lane votes a
-        different NON-NIL block (the blame disambiguator for
-        CommitPowerError).
+        idxs[N], foreign_power int) — foreign_power totals the voting
+        power of lanes endorsing a different NON-NIL block (the blame
+        disambiguator for CommitPowerError: a single Byzantine stray
+        vote must not redirect fast-sync blame when the real defect is a
+        pruned LastCommit).
         """
         if self.size() != commit.size():
             raise ValueError(
@@ -311,7 +313,7 @@ class ValidatorSet:
         tmpl_of: dict[tuple, int] = {}
         templates: list[bytes] = []
         tmpl_idx, sigs, powers, idxs = [], [], [], []
-        foreign = False
+        foreign_power = 0
         for idx, v in enumerate(commit.precommits):
             if v is None:
                 continue
@@ -341,7 +343,7 @@ class ValidatorSet:
             else:
                 powers.append(0)
                 if not v.block_id.is_zero():
-                    foreign = True
+                    foreign_power += val.voting_power
             idxs.append(idx)
         n = len(idxs)
         return (
@@ -351,7 +353,7 @@ class ValidatorSet:
             np.frombuffer(b"".join(sigs), np.uint8).reshape(n, 64),
             np.asarray(powers, dtype=np.int64),
             np.asarray(idxs, dtype=np.int32),
-            foreign,
+            foreign_power,
         )
 
     def verify_commit(self, chain_id: str, block_id, height: int,
@@ -360,7 +362,7 @@ class ValidatorSet:
         (reference `types/validator_set.go:220-264`); signatures checked in
         one crypto-backend batch against this set's cached comb tables."""
         from tendermint_tpu.crypto import backend as cb
-        templates, tmpl_idx, sigs, powers, idxs, foreign = \
+        templates, tmpl_idx, sigs, powers, idxs, foreign_power = \
             self.commit_verify_lanes(chain_id, block_id, height, commit)
         ok = cb.verify_grouped_templated(
             self.set_key(), self.pubs_matrix(), idxs, tmpl_idx,
@@ -369,7 +371,10 @@ class ValidatorSet:
             raise CommitSignatureError(height, int(np.argmin(ok)))
         tallied = int(powers.sum())
         if not tallied * 3 > self._total * 2:
-            raise CommitPowerError(height, tallied, self._total, foreign)
+            raise CommitPowerError(
+                height, tallied, self._total,
+                _foreign_explains_shortfall(tallied, foreign_power,
+                                            self._total))
 
     def __str__(self):
         return (f"ValidatorSet[{self.size()} vals, "
@@ -422,7 +427,20 @@ def verify_commits_batched(val_set: ValidatorSet, chain_id: str,
             raise CommitSignatureError(h, int(np.argmin(lane_ok)))
         tallied = int(a[3].sum())
         if not tallied * 3 > total * 2:
-            raise CommitPowerError(h, tallied, total, a[5])
+            raise CommitPowerError(
+                h, tallied, total,
+                _foreign_explains_shortfall(tallied, a[5], total))
+
+
+def _foreign_explains_shortfall(tallied: int, foreign_power: int,
+                                total: int) -> bool:
+    """Blame disambiguation for CommitPowerError: only call the block
+    itself foreign (redo THIS height) when the power endorsing other
+    non-nil blocks is large enough that, had those votes endorsed ours,
+    the commit would have reached +2/3 — a lone Byzantine stray vote
+    cannot redirect blame from a pruned LastCommit (whose fix is redoing
+    height+1)."""
+    return (tallied + foreign_power) * 3 > total * 2
 
 
 def _neg_addr(addr: bytes) -> bytes:

@@ -41,13 +41,19 @@ class Meter:
 
     def _roll(self, now: float) -> None:
         elapsed = now - self._window_start
-        while elapsed >= _WINDOW:
-            sample = self._window_bytes / _WINDOW
-            self._rate = (_ALPHA * sample + (1 - _ALPHA) * self._rate
-                          if self._rate or sample else 0.0)
-            self._window_bytes = 0
-            self._window_start += _WINDOW
-            elapsed -= _WINDOW
+        if elapsed < _WINDOW:
+            return
+        n = int(elapsed / _WINDOW)
+        sample = self._window_bytes / _WINDOW
+        self._rate = (_ALPHA * sample + (1 - _ALPHA) * self._rate
+                      if self._rate or sample else 0.0)
+        if n > 1:
+            # the remaining n-1 windows are empty: decay in closed form
+            # instead of iterating (an hour-idle meter would otherwise
+            # spin ~14k loop iterations under the lock)
+            self._rate *= (1 - _ALPHA) ** (n - 1)
+        self._window_bytes = 0
+        self._window_start += n * _WINDOW
 
     def rate(self, now: float | None = None) -> float:
         """Bytes/second, exponentially averaged over recent windows."""

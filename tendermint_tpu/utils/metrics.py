@@ -90,7 +90,9 @@ class Registry:
         self.sigs_requested = Counter()       # real signatures asked for
         self.verify_batches = Counter()
         self.batch_occupancy = Summary()      # real/padded per batch
-        self.device_step_seconds = Summary()  # wall time per device call
+        self.device_step_seconds = Summary()  # wait-for-result per call
+        self.device_dispatch_seconds = Summary()  # dispatch->result wall
+        #   (includes overlapped host work in pipelined callers)
         self.table_build_seconds = Summary()  # comb-table builds (per set)
         # sync plane
         self.blocks_synced = Counter()
@@ -114,6 +116,8 @@ class Registry:
             "batch_occupancy_mean": round(self.batch_occupancy.mean, 4),
             "device_step_seconds_mean":
                 round(self.device_step_seconds.mean, 6),
+            "device_dispatch_seconds_mean":
+                round(self.device_dispatch_seconds.mean, 6),
             "blocks_synced": self.blocks_synced.value,
             "peers": self.peers.value,
             "p2p_msgs_sent": self.msgs_sent.value,
