@@ -184,56 +184,136 @@ def cmd_replay(args) -> int:
     return 0
 
 
-def cmd_replay_console(args) -> int:
-    """Interactive WAL stepper (reference `consensus/replay.go` console:
-    inspect every journalled consensus input one record at a time).
-
-    Commands: <enter>/n = next record, d = dump decoded payload,
-    q = quit.  Non-tty stdin steps through everything (scriptable).
-    """
+def _describe_record(i: int, kind: int, payload: bytes) -> str:
     import struct
     from tendermint_tpu.consensus import messages as M
     from tendermint_tpu.consensus.wal import (REC_ENDHEIGHT, REC_MESSAGE,
-                                              REC_TIMEOUT, WAL)
+                                              REC_TIMEOUT)
+    if kind == REC_ENDHEIGHT:
+        return f"[{i}] ENDHEIGHT {struct.unpack('>Q', payload)[0]}"
+    if kind == REC_TIMEOUT:
+        h, r, s = struct.unpack(">QIB", payload)
+        return f"[{i}] TIMEOUT h={h} r={r} step={s}"
+    if kind == REC_MESSAGE:
+        try:
+            return f"[{i}] MESSAGE {type(M.decode_msg(payload)).__name__}"
+        except Exception:
+            return f"[{i}] MESSAGE <undecodable {len(payload)}B>"
+    return f"[{i}] kind={kind} ({len(payload)}B)"
+
+
+def cmd_replay_console(args) -> int:
+    """Interactive WAL playback console (reference
+    `consensus/replay_file.go:76-230`): a live ConsensusState is driven
+    record by record from the consensus WAL.
+
+    Commands: next [N], back [N] (reset + re-feed, reference
+    replayReset), until H (run to ENDHEIGHT H), rs [short|validators|
+    proposal|proposal_block|locked_round|locked_block|votes], d (dump
+    the next record), n (position), q.  Non-tty stdin feeds everything
+    through (scriptable smoke-replay).
+    """
+    from tendermint_tpu.consensus import messages as M
+    from tendermint_tpu.consensus.replay import Playback
+    from tendermint_tpu.consensus.wal import REC_MESSAGE
+    from tendermint_tpu.types.genesis import GenesisDoc
     cfg = _load_config(args)
     wal_path = os.path.join(cfg.base.db_dir(), "cs.wal")
-    recs = WAL.read_all(wal_path)
-    print(f"{len(recs)} records in {wal_path}")
-    interactive = sys.stdin.isatty()
-    for i, (kind, payload) in enumerate(recs):
-        if kind == REC_ENDHEIGHT:
-            desc = f"ENDHEIGHT {struct.unpack('>Q', payload)[0]}"
-        elif kind == REC_TIMEOUT:
-            h, r, s = struct.unpack(">QIB", payload)
-            desc = f"TIMEOUT h={h} r={r} step={s}"
-        elif kind == REC_MESSAGE:
+    gen = GenesisDoc.load(cfg.base.genesis_file())
+    pb = Playback(gen, wal_path,
+                  proxy_app=cfg.base.proxy_app or "kvstore",
+                  cfg=cfg.consensus)
+    print(f"{len(pb.records)} records in {wal_path}")
+    if not sys.stdin.isatty():
+        while pb.count < len(pb.records):
+            print(_describe_record(pb.count, *pb.records[pb.count]))
+            pb.next(1)
+        print(f"final round state: {pb.round_state('short')}")
+        return 0
+    while True:
+        try:
+            line = input(f"[{pb.count}/{len(pb.records)} "
+                         f"{pb.round_state('short')}]> ").strip()
+        except EOFError:
+            break
+        tok = line.split()
+        cmd = tok[0] if tok else "next"
+
+        def _arg_int(default=None):
+            """Numeric argument or None; a typo must not crash the
+            console and lose the replayed position."""
+            if len(tok) < 2:
+                return default
             try:
-                desc = f"MESSAGE {type(M.decode_msg(payload)).__name__}"
-            except Exception:
-                desc = f"MESSAGE <undecodable {len(payload)}B>"
-        else:
-            desc = f"kind={kind} ({len(payload)}B)"
-        print(f"[{i}] {desc}")
-        if interactive:
-            try:
-                cmdline = input("(n)ext / (d)ump / (q)uit> ").strip().lower()
-            except EOFError:        # Ctrl-D: exit like 'q'
-                break
-            if cmdline == "q":
-                break
-            if cmdline == "d":
+                return int(tok[1])
+            except ValueError:
+                print(f"{cmd} takes an integer argument")
+                return None
+
+        if cmd in ("q", "quit"):
+            break
+        elif cmd == "next":
+            n = _arg_int(1)
+            if n is None:
+                continue
+            for _ in range(n):
+                if pb.count >= len(pb.records):
+                    print("(end of WAL)")
+                    break
+                print(_describe_record(pb.count, *pb.records[pb.count]))
+                pb.next(1)
+        elif cmd == "back":
+            n = _arg_int(1)
+            if n is None:
+                continue
+            if n > pb.count:
+                print(f"back must be <= current count ({pb.count})")
+            else:
+                pb.back(n)
+                print(f"reset and re-fed {pb.count} records")
+        elif cmd == "until":
+            h = _arg_int()
+            if h is None:
+                print("until takes a height")
+            else:
+                pb.run_until(h)
+        elif cmd == "rs":
+            print(pb.round_state(tok[1] if len(tok) > 1 else "short"))
+        elif cmd == "n":
+            print(pb.count)
+        elif cmd == "d":
+            if pb.count < len(pb.records):
+                kind, payload = pb.records[pb.count]
                 if kind == REC_MESSAGE:
                     try:
-                        print("   ", M.decode_msg(payload))
+                        print(M.decode_msg(payload))
                     except Exception as e:
-                        print("    undecodable:", e)
+                        print("undecodable:", e)
                 else:
-                    print("   ", payload.hex())
+                    print(payload.hex())
+        else:
+            print("commands: next [N] | back [N] | until H | rs [field] "
+                  "| d | n | q")
     return 0
 
 
 def cmd_version(args) -> int:
     print(__version__)
+    return 0
+
+
+def cmd_probe_upnp(args) -> int:
+    """Test UPnP functionality (reference
+    `cmd/tendermint/commands/probe_upnp.go:1-35`)."""
+    import json as _json
+    from tendermint_tpu.p2p import upnp
+    try:
+        caps = upnp.probe(int_port=args.int_port, ext_port=args.ext_port)
+    except upnp.UPnPError as e:
+        print(f"Probe failed: {e}")
+        return 1
+    print("Probe success!")
+    print(_json.dumps(caps))
     return 0
 
 
@@ -287,6 +367,11 @@ def main(argv=None) -> int:
 
     sp = sub.add_parser("version", help="print version")
     sp.set_defaults(fn=cmd_version)
+
+    sp = sub.add_parser("probe_upnp", help="test UPnP functionality")
+    sp.add_argument("--int-port", dest="int_port", type=int, default=20000)
+    sp.add_argument("--ext-port", dest="ext_port", type=int, default=20000)
+    sp.set_defaults(fn=cmd_probe_upnp)
 
     args = p.parse_args(argv)
     return args.fn(args)

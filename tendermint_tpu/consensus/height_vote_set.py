@@ -45,9 +45,12 @@ class HeightVoteSet:
     def round(self) -> int:
         return self._round
 
-    def add_vote(self, vote, peer_id: str = "") -> bool:
+    def add_vote(self, vote, peer_id: str = "", verify: bool = True) -> bool:
         """Route to the vote's round; peers may push up to 2 catchup
-        rounds beyond the current one (reference `:105-128`)."""
+        rounds beyond the current one (reference `:105-128`).
+        `verify=False` skips the signature check for votes the caller
+        already verified in a device micro-batch (consensus receive-loop
+        burst ingestion)."""
         with self._lock:
             vs = self._get(vote.round, vote.type)
             if vs is None:
@@ -60,7 +63,7 @@ class HeightVoteSet:
                     raise ValueError(
                         f"peer {peer_id!r} exceeded catchup-round quota")
                 vs = self._get(vote.round, vote.type, create=True)
-        return vs.add_vote(vote)
+        return vs.add_vote(vote, verify=verify)
 
     def prevotes(self, round_: int) -> VoteSet | None:
         with self._lock:

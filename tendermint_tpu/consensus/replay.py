@@ -131,3 +131,107 @@ class Handshaker:
                               meta.block_id.parts, execution.MockMempool())
         self.n_blocks += 1
         return self.state.app_hash
+
+
+class Playback:
+    """Replay-console playback manager (reference
+    `consensus/replay_file.go:76-141`): drives a live ConsensusState from
+    a consensus WAL record by record, with seek-back and run-until.
+
+    "back" is not expressible in the state machine (reference comment at
+    `:117` — replays can only be reset to the beginning), so `back(n)`
+    rebuilds a fresh ConsensusState from genesis and re-feeds
+    `count - n` records, exactly the reference's `replayReset`.
+    """
+
+    def __init__(self, genesis, wal_path: str, proxy_app: str = "kvstore",
+                 cfg=None):
+        from tendermint_tpu import config as config_mod
+        from tendermint_tpu.consensus.wal import WAL
+        self.genesis = genesis
+        self.proxy_app = proxy_app
+        self.cfg = cfg or config_mod.test_config().consensus
+        self.records = WAL.read_all(wal_path)
+        self.count = 0
+        self.cs = self._fresh_cs()
+
+    def _fresh_cs(self):
+        from tendermint_tpu.blockchain.store import BlockStore
+        from tendermint_tpu.consensus.state import ConsensusState
+        from tendermint_tpu.mempool.mempool import Mempool
+        from tendermint_tpu.proxy import ClientCreator
+        from tendermint_tpu.state.state import get_state
+        from tendermint_tpu.utils.db import MemDB
+        conns = ClientCreator(self.proxy_app).new_app_conns()
+        st = get_state(MemDB(), self.genesis)
+        cs = ConsensusState(self.cfg, st, conns.consensus,
+                            BlockStore(MemDB()), Mempool(conns.mempool))
+        cs._replay_mode = True      # never writes a WAL, never signs
+        return cs
+
+    def _feed_one(self, kind: int, payload: bytes) -> None:
+        import struct as _struct
+        from tendermint_tpu.consensus import messages as M
+        from tendermint_tpu.consensus.state import TimeoutInfo
+        from tendermint_tpu.consensus.wal import REC_MESSAGE, REC_TIMEOUT
+        try:
+            if kind == REC_MESSAGE:
+                self.cs._handle_msg(M.decode_msg(payload), "")
+            elif kind == REC_TIMEOUT:
+                h, r, s = _struct.unpack(">QIB", payload)
+                self.cs._handle_timeout(TimeoutInfo(h, r, s))
+            # ENDHEIGHT markers carry no input to the machine
+        except Exception:
+            from tendermint_tpu.utils.log import get_logger
+            get_logger("replay").exception("error replaying WAL record")
+
+    def next(self, n: int = 1) -> int:
+        """Feed the next n records; returns how many were fed."""
+        fed = 0
+        while fed < n and self.count < len(self.records):
+            self._feed_one(*self.records[self.count])
+            self.count += 1
+            fed += 1
+        return fed
+
+    def back(self, n: int = 1) -> None:
+        """Rebuild from genesis and re-feed count-n records (reference
+        `replayReset`)."""
+        target = max(0, self.count - n)
+        self.cs = self._fresh_cs()
+        self.count = 0
+        self.next(target)
+
+    def run_until(self, height: int) -> None:
+        """Feed records until the ENDHEIGHT marker for `height` (i.e.
+        the machine has fully committed that height) or EOF."""
+        import struct as _struct
+        from tendermint_tpu.consensus.wal import REC_ENDHEIGHT
+        while self.count < len(self.records):
+            kind, payload = self.records[self.count]
+            self._feed_one(kind, payload)
+            self.count += 1
+            if kind == REC_ENDHEIGHT and \
+                    _struct.unpack(">Q", payload)[0] >= height:
+                return
+
+    def round_state(self, what: str = "") -> str:
+        """Inspection (reference console `rs [short|...]`)."""
+        rs = self.cs.get_round_state()
+        if what == "short" or what == "":
+            return f"{rs.height}/{rs.round}/{rs.step}"
+        if what == "validators":
+            return str([v.address.hex()[:12]
+                        for v in rs.validators.validators])
+        if what == "proposal":
+            return str(rs.proposal)
+        if what == "proposal_block":
+            return (f"parts={rs.proposal_block_parts} "
+                    f"block={rs.proposal_block is not None}")
+        if what == "locked_round":
+            return str(rs.locked_round)
+        if what == "locked_block":
+            return str(rs.locked_block is not None)
+        if what == "votes":
+            return str(rs.votes)
+        return f"unknown field {what!r}"

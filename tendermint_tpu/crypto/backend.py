@@ -94,6 +94,11 @@ class TpuBackend:
         _enable_compile_cache()
         self._jnp = jnp
         self._dev = dev
+        # fixed-base comb table, uploaded once and passed as an ARGUMENT
+        # to every jitted entry point — baked in as a graph constant the
+        # 8.6 MB literal adds ~5s of XLA compile per executable
+        from tendermint_tpu.ops import curve as _curve
+        self._base_tbl = jnp.asarray(_curve._base_table())
         # set_key -> (tbl, ok, V, staged key matrix)
         self._tables: dict[bytes, tuple] = {}
         # seed-set hash -> staged (a, prefix, pubkey) sign matrices
@@ -189,6 +194,54 @@ class TpuBackend:
             self._tables[set_key] = ent
         return ent
 
+    def _warm_verify_if_cold(self, set_key: bytes, n_vals: int,
+                             kind: str, shape: tuple):
+        """Overlap the verify executable's XLA compile with the comb-table
+        build on a COLD set: the compile needs only shapes, so a dummy
+        call with zero tables runs on a thread while `_set_tables` pays
+        the (similarly long) build compile — the two overlap almost
+        fully, halving cold first-call latency (VERDICT r4 #3).  Returns
+        the thread (caller joins after tables are ready), or None when
+        the set is already cached."""
+        if self._mesh is not None:
+            return None     # mesh path compiles per-shape sharded fns
+        with self._tables_lock:
+            if set_key in self._tables:
+                return None
+        jnp = self._jnp
+        vb = _bucket(n_vals)
+        from tendermint_tpu.ops.curve import COMB_DIGITS, COMB_WINDOWS
+
+        def warm():
+            try:
+                ztbl = jnp.zeros((COMB_WINDOWS, COMB_DIGITS, vb, 3, 32),
+                                 jnp.uint8)
+                zok = jnp.zeros((vb,), bool)
+                zvp = jnp.zeros((vb, 32), jnp.uint8)
+                if kind == "templated":
+                    b, tb, mlen = shape
+                    out = self._dev.verify_grouped_templated_jit(
+                        ztbl, zok, zvp, jnp.zeros((b,), jnp.int32),
+                        jnp.zeros((b,), jnp.int32),
+                        jnp.zeros((tb, mlen), jnp.uint8),
+                        jnp.zeros((b, 64), jnp.uint8), self._base_tbl)
+                else:
+                    b, mlen = shape
+                    # pubkeys here are PER-LANE (challenge-hash input),
+                    # so the warm shape is the lane bucket, not vb
+                    out = self._dev.verify_grouped_jit(
+                        ztbl, zok, jnp.zeros((b,), jnp.int32),
+                        jnp.zeros((b, 32), jnp.uint8),
+                        jnp.zeros((b, mlen), jnp.uint8),
+                        jnp.zeros((b, 64), jnp.uint8), self._base_tbl)
+                out.block_until_ready()
+            except Exception:
+                pass                   # warm-up is best-effort only
+
+        t = threading.Thread(target=warm, daemon=True)
+        t.start()
+        return t
+
     def verify_grouped_templated(self, set_key, val_pubs, val_idx,
                                  tmpl_idx, templates, sigs):
         """Grouped verify shipping only (sig, val_idx, tmpl_idx) lanes
@@ -209,7 +262,12 @@ class TpuBackend:
         n = len(val_idx)
         if n == 0:
             return lambda: np.zeros(0, dtype=bool)
+        warm = self._warm_verify_if_cold(
+            set_key, len(val_pubs), "templated",
+            (_bucket(n), _bucket(len(templates)), templates.shape[1]))
         tbl, pub_ok, v, vp_dev = self._set_tables(set_key, val_pubs)
+        if warm is not None:
+            warm.join()
         if v != len(val_pubs):
             raise ValueError(
                 f"set_key reused for a different set size ({v} != "
@@ -238,7 +296,7 @@ class TpuBackend:
         dev_out = self._dev.verify_grouped_templated_jit(
             tbl, pub_ok, vp_dev, jnp.asarray(val_idx.astype(np.int32)),
             jnp.asarray(tmpl_idx.astype(np.int32)),
-            jnp.asarray(templates), jnp.asarray(sigs))
+            jnp.asarray(templates), jnp.asarray(sigs), self._base_tbl)
 
         def collect() -> np.ndarray:
             # time only the wait-for-result here: a pipelined caller does
@@ -312,7 +370,7 @@ class TpuBackend:
         jnp = self._jnp
         out = np.asarray(self._dev.sign_grouped_templated_jit(
             a_dev, pre_dev, pubs_dev, jnp.asarray(val_idx),
-            jnp.asarray(tmpl_idx), jnp.asarray(templates)))
+            jnp.asarray(tmpl_idx), jnp.asarray(templates), self._base_tbl))
         return out[:n]
 
     def precompile(self, set_key: bytes, val_pubs: np.ndarray,
@@ -369,7 +427,11 @@ class TpuBackend:
         n = len(val_idx)
         if n == 0:
             return np.zeros(0, dtype=bool)
+        warm = self._warm_verify_if_cold(
+            set_key, len(val_pubs), "plain", (_bucket(n), msgs.shape[-1]))
         tbl, pub_ok, v, _ = self._set_tables(set_key, val_pubs)
+        if warm is not None:
+            warm.join()
         if v != len(val_pubs):       # stale key reuse would verify against
             raise ValueError(        # the wrong table — refuse loudly
                 f"set_key reused for a different set size ({v} != "
@@ -391,7 +453,8 @@ class TpuBackend:
         else:
             out = self._dev.verify_grouped_jit(
                 tbl, pub_ok, jnp.asarray(val_idx.astype(np.int32)),
-                jnp.asarray(pubkeys), jnp.asarray(msgs), jnp.asarray(sigs))
+                jnp.asarray(pubkeys), jnp.asarray(msgs), jnp.asarray(sigs),
+                self._base_tbl)
         out = np.asarray(out)
         dt = time.perf_counter() - t0
         REGISTRY.device_step_seconds.observe(dt)      # sync: step ==

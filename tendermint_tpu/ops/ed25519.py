@@ -77,7 +77,8 @@ build_neg_comb_jit = jax.jit(build_neg_comb)
 
 def verify_grouped(tables: jnp.ndarray, pub_ok: jnp.ndarray,
                    val_idx: jnp.ndarray, pubkeys: jnp.ndarray,
-                   msgs: jnp.ndarray, sigs: jnp.ndarray) -> jnp.ndarray:
+                   msgs: jnp.ndarray, sigs: jnp.ndarray,
+                   base_tbl: jnp.ndarray | None = None) -> jnp.ndarray:
     """Grouped verify: lane i checks sig[i] by validator val_idx[i] using
     cached affine comb tables — ~8x fewer field muls than `verify`:
 
@@ -100,7 +101,10 @@ def verify_grouped(tables: jnp.ndarray, pub_ok: jnp.ndarray,
     k = sc.reduce512(s512.sha512(challenge))
     s_bytes = sigs[..., 32:]
     ok_s = sc.lt_L(s_bytes)
-    sB = curve.scalar_mul_base(s_bytes)
+    # [s]B and [k](-A) stay SEPARATE scans on purpose: the two comb
+    # chains are independent, so the device overlaps them — a merged
+    # single-accumulator scan measured ~40% slower at 64k lanes
+    sB = curve.scalar_mul_base(s_bytes, base_tbl)
     kA = curve.scalar_mul_comb(tables, val_idx, k)
     enc, ok_z = curve.encode_batch(curve.pt_add(sB, kA))
     ok_r = jnp.all(enc == sigs[..., :32], axis=-1)
@@ -112,8 +116,9 @@ verify_grouped_jit = jax.jit(verify_grouped)
 
 def sign_grouped_templated(a_scalars: jnp.ndarray, prefixes: jnp.ndarray,
                            pubkeys: jnp.ndarray, val_idx: jnp.ndarray,
-                           tmpl_idx: jnp.ndarray,
-                           templates: jnp.ndarray) -> jnp.ndarray:
+                           tmpl_idx: jnp.ndarray, templates: jnp.ndarray,
+                           base_tbl: jnp.ndarray | None = None
+                           ) -> jnp.ndarray:
     """Batched RFC 8032 signing against a fixed key set: lane i signs
     templates[tmpl_idx[i]] with key val_idx[i].  Returns sigs uint8[N, 64].
 
@@ -134,7 +139,7 @@ def sign_grouped_templated(a_scalars: jnp.ndarray, prefixes: jnp.ndarray,
     A = jnp.take(pubkeys, val_idx, axis=0)                  # [N, 32]
     a = jnp.take(a_scalars, val_idx, axis=0)                # [N, 32]
     r = sc.reduce512(s512.sha512(jnp.concatenate([prefix, msgs], axis=-1)))
-    R_bytes, _ = curve.encode_batch(curve.scalar_mul_base(r))
+    R_bytes, _ = curve.encode_batch(curve.scalar_mul_base(r, base_tbl))
     k = sc.reduce512(s512.sha512(
         jnp.concatenate([R_bytes, A, msgs], axis=-1)))
     s = sc.muladd_mod_L(k, a, r)
@@ -148,8 +153,9 @@ sign_grouped_templated_jit = jax.jit(sign_grouped_templated)
 def verify_grouped_templated(tables: jnp.ndarray, pub_ok: jnp.ndarray,
                              val_pubs: jnp.ndarray, val_idx: jnp.ndarray,
                              tmpl_idx: jnp.ndarray,
-                             templates: jnp.ndarray,
-                             sigs: jnp.ndarray) -> jnp.ndarray:
+                             templates: jnp.ndarray, sigs: jnp.ndarray,
+                             base_tbl: jnp.ndarray | None = None
+                             ) -> jnp.ndarray:
     """Grouped verify with DEVICE-side message/pubkey assembly.
 
     Vote sign-bytes exclude the signer, so every lane of a commit that
@@ -163,7 +169,8 @@ def verify_grouped_templated(tables: jnp.ndarray, pub_ok: jnp.ndarray,
     """
     msgs = jnp.take(templates, tmpl_idx, axis=0)
     pubkeys = jnp.take(val_pubs, val_idx, axis=0)
-    return verify_grouped(tables, pub_ok, val_idx, pubkeys, msgs, sigs)
+    return verify_grouped(tables, pub_ok, val_idx, pubkeys, msgs, sigs,
+                          base_tbl)
 
 
 verify_grouped_templated_jit = jax.jit(verify_grouped_templated)

@@ -106,23 +106,39 @@ def _fold_carry(acc: jnp.ndarray) -> jnp.ndarray:
     return carry(lo, passes=4)
 
 
-def mul_basic(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """Schoolbook product via padded int32 rows — the compiler-safe path.
+# Fixed anti-diagonal scatter: column k of M sums outer-product entries
+# (i, j) with i + j == k, turning the limb product into one MXU matmul.
+_ADIAG = np.zeros((NLIMBS * NLIMBS, 2 * NLIMBS - 1), np.float32)
+for _i in range(NLIMBS):
+    for _j in range(NLIMBS):
+        _ADIAG[_i * NLIMBS + _j, _i + _j] = 1.0
 
-    Slower than `mul`'s convolution form but accepted by the TPU compiler
-    in every context; used for >2-d shapes and inside the inversion
-    ladders/batch inversion, where the batch-grouped conv aborts the
-    Mosaic pipeline (SIGABRT in tpu_compile_helper, observed on v5e).
+
+def mul_basic(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Schoolbook product as outer-product + one f32 matmul — the
+    compile-cheap path.
+
+    The elementwise outer [..., 32, 32] (entries <= 512^2, f32-exact) is
+    contracted against the fixed 0/1 anti-diagonal matrix on the MXU with
+    Precision.HIGHEST (full f32: column sums <= 32*512^2 < 2^24 stay
+    exact; the TPU default bf16 passes would truncate).  XLA compiles a
+    plain dot in well under a second where the previous padded-row
+    formulation (32 pads + stack + sum per mul) ballooned chain graphs —
+    a 20-mul chain measured 69s to compile vs 5s for this form, which is
+    what made the 10-bit comb build pay 130s+ of jit (VERDICT r4 #3).
+    Works for any rank (the conv form's >2-d Mosaic SIGABRT does not
+    apply); runtime is within ~25% of the conv on 2-d shapes, so the
+    conv stays the hot-verify mul and this serves everything else.
     """
     shape = jnp.broadcast_shapes(a.shape, b.shape)
-    a = jnp.broadcast_to(a, shape)
-    b = jnp.broadcast_to(b, shape)
-    pads = [(0, 0)] * (len(shape) - 1)
-    rows = [
-        jnp.pad(a[..., i:i + 1] * b, pads + [(i, NLIMBS - 1 - i)])
-        for i in range(NLIMBS)
-    ]
-    return _fold_carry(jnp.sum(jnp.stack(rows, axis=0), axis=0))
+    af = jnp.broadcast_to(a, shape).astype(jnp.float32)
+    bf = jnp.broadcast_to(b, shape).astype(jnp.float32)
+    outer = (af[..., :, None] * bf[..., None, :]).reshape(
+        shape[:-1] + (NLIMBS * NLIMBS,))
+    prod = jax.lax.dot_general(
+        outer, jnp.asarray(_ADIAG), (((outer.ndim - 1,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST)
+    return _fold_carry(prod.astype(jnp.int32))
 
 
 def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
@@ -133,16 +149,20 @@ def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     under the |limb| <= 512 invariant every column sum is below
     32*512*512 < 2^24, so f32 accumulation is exact, and
     `Precision.HIGHEST` pins the TPU conv to f32-faithful passes.  The
-    int32 padded-row formulation (`mul_basic`) materialized a [32, N, 63]
-    stack per mul that XLA never fused — the verify kernel measured
-    HBM-traffic-bound (~264 KB/lane, 17.3 GB/call at 64k lanes) — and
-    int32 multiplies take the VPU's slow path besides.  Shapes deeper
-    than 2-d fall back to `mul_basic` (the conv+reshape combination
-    SIGABRTs the TPU compiler there).
+    conv edges out `mul_basic`'s matmul form by ~25% at steady state but
+    costs ~4x more XLA compile time, so it serves only the flat hot-path
+    shapes: big 2-d batches.  Small batches (< 4096 lanes — table-build
+    chains over V validators, recursion totals) take `mul_basic`, where
+    runtime is negligible and compile time is what hurts; shapes deeper
+    than 2-d also fall back (the conv+reshape combination SIGABRTs the
+    TPU compiler there).
     """
-    if max(a.ndim, b.ndim) > 2:
-        return mul_basic(a, b)
     shape = jnp.broadcast_shapes(a.shape, b.shape)
+    flat = 1
+    for d in shape[:-1]:
+        flat *= d
+    if len(shape) > 2 or flat < 4096:
+        return mul_basic(a, b)
     a = jnp.broadcast_to(a, shape)
     b = jnp.broadcast_to(b, shape)
     n = 1
@@ -168,45 +188,34 @@ def mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:
     return carry(a * k, passes=2)
 
 
-def _nsqr(x: jnp.ndarray, n: int) -> jnp.ndarray:
-    # fori_loop keeps the inversion ladder's XLA graph at one sqr per chain
-    # link instead of unrolling ~254 of them; mul_basic — see its docstring.
-    if n < 4:
-        for _ in range(n):
-            x = mul_basic(x, x)
-        return x
-    return jax.lax.fori_loop(0, n, lambda _, v: mul_basic(v, v), x)
+def _pow_const(z: jnp.ndarray, exp: int) -> jnp.ndarray:
+    """z^exp via one square-and-multiply scan over the static bit string.
 
+    ~2x the multiplies of the ref10 addition chain (508 vs 265 for p-2),
+    but the whole ladder is ONE two-mul scan body for XLA — the chain's
+    ~30 distinct mul/fori sites were several seconds of compile at every
+    ladder call site (decompress, batch inversion), and ladders run
+    either on tiny shapes (V keys, recursion totals) or once per batch,
+    so the extra multiplies are noise at runtime.
+    """
+    bits = jnp.asarray(np.array([int(b) for b in bin(exp)[3:]], np.bool_))
 
-def _pow_core(z: jnp.ndarray):
-    """Shared ladder: returns (z^(2^250-1), z^11).  Built on `mul_basic`
-    throughout: the ladder runs inside batch inversion and decompress,
-    where the conv form crashes the TPU compiler."""
-    mul_ = mul_basic
-    z2 = mul_(z, z)
-    z9 = mul_(_nsqr(z2, 2), z)
-    z11 = mul_(z9, z2)
-    z_5_0 = mul_(mul_(z11, z11), z9)          # z^(2^5 - 1)
-    z_10_0 = mul_(_nsqr(z_5_0, 5), z_5_0)     # z^(2^10 - 1)
-    z_20_0 = mul_(_nsqr(z_10_0, 10), z_10_0)
-    z_40_0 = mul_(_nsqr(z_20_0, 20), z_20_0)
-    z_50_0 = mul_(_nsqr(z_40_0, 10), z_10_0)
-    z_100_0 = mul_(_nsqr(z_50_0, 50), z_50_0)
-    z_200_0 = mul_(_nsqr(z_100_0, 100), z_100_0)
-    z_250_0 = mul_(_nsqr(z_200_0, 50), z_50_0)
-    return z_250_0, z11
+    def body(acc, bit):
+        acc = mul_basic(acc, acc)
+        return jnp.where(bit, mul_basic(acc, z), acc), None
+
+    acc, _ = jax.lax.scan(body, z, bits)
+    return acc
 
 
 def inv(z: jnp.ndarray) -> jnp.ndarray:
-    """z^(p-2) = z^(2^255 - 21) via the ref10-style addition chain."""
-    z_250_0, z11 = _pow_core(z)
-    return mul_basic(_nsqr(z_250_0, 5), z11)
+    """z^(p-2) = z^(2^255 - 21)."""
+    return _pow_const(z, P - 2)
 
 
 def pow22523(z: jnp.ndarray) -> jnp.ndarray:
     """z^((p-5)/8) = z^(2^252 - 3)."""
-    z_250_0, _ = _pow_core(z)
-    return mul_basic(_nsqr(z_250_0, 2), z)
+    return _pow_const(z, (P - 5) // 8)
 
 
 def _batch_inv_nonzero(z: jnp.ndarray) -> jnp.ndarray:

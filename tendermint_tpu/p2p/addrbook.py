@@ -30,6 +30,8 @@ NEW_BUCKETS = 256
 OLD_BUCKETS = 64
 BUCKET_SIZE = 64
 NEW_BUCKETS_PER_SRC = 8   # reference p2p/addrbook.go newBucketsPerGroup
+MAX_FAILURES = 10         # reference numRetries/maxFailures isBad() bound
+STALE_AFTER = 30 * 24 * 3600.0   # attempts older than this are expirable
 
 
 class _Entry:
@@ -48,7 +50,8 @@ class _Entry:
     def to_json(self) -> dict:
         return {"addr": str(self.addr), "src": self.src,
                 "attempts": self.attempts, "old": self.old,
-                "last_success": self.last_success}
+                "last_success": self.last_success,
+                "last_attempt": self.last_attempt}
 
     @classmethod
     def from_json(cls, d: dict) -> "_Entry":
@@ -56,6 +59,7 @@ class _Entry:
         e.attempts = int(d.get("attempts", 0))
         e.old = bool(d.get("old", False))
         e.last_success = float(d.get("last_success", 0.0))
+        e.last_attempt = float(d.get("last_attempt", 0.0))
         return e
 
 
@@ -101,6 +105,15 @@ class AddrBook:
         return [e for e in self._entries.values()
                 if e.old == old and e.bucket == bucket]
 
+    @staticmethod
+    def _is_bad(e: _Entry, now: float) -> bool:
+        """Expirable under pressure (reference `isBad`): repeatedly
+        failed and never proven, or untouched for a month."""
+        if e.last_success == 0.0 and e.attempts >= MAX_FAILURES:
+            return True
+        ref = max(e.last_attempt, e.last_success)
+        return ref != 0.0 and now - ref > STALE_AFTER
+
     # -- mutation -------------------------------------------------------
     def add_address(self, addr: NetAddress, src: str = "") -> bool:
         key = addr.dial_string()
@@ -113,8 +126,12 @@ class AddrBook:
             e.bucket = self._new_bucket_of(key, src)
             members = self._bucket_members(e.bucket, old=False)
             if len(members) >= BUCKET_SIZE:
-                # randomized eviction of an unvetted address
-                evict = self._rng.choice(members)
+                # expire a provably-bad entry first (reference expireNew);
+                # only healthy-looking buckets lose a RANDOM member
+                now = time.time()
+                bad = [m for m in members if self._is_bad(m, now)]
+                evict = (self._rng.choice(bad) if bad
+                         else self._rng.choice(members))
                 self._entries.pop(evict.addr.dial_string(), None)
             self._entries[key] = e
             return True

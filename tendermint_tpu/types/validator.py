@@ -137,24 +137,44 @@ class ValidatorSet:
         # membership-derived caches survive a copy (invalidated only by
         # apply_updates); the hash also survives accum rotation because
         # hash_bytes excludes accum
-        for attr in ("_set_key", "_pubs_mat", "_hash"):
+        for attr in ("_set_key", "_pubs_mat", "_hash", "_powers"):
             if attr in self.__dict__:
                 new.__dict__[attr] = self.__dict__[attr]
         return new
 
     # -- proposer rotation ---------------------------------------------
+    def _powers_arr(self) -> np.ndarray:
+        p = self.__dict__.get("_powers")
+        if p is None:
+            p = self.__dict__["_powers"] = np.array(
+                [v.voting_power for v in self.validators], dtype=np.int64)
+        return p
+
     def increment_accum(self, times: int) -> None:
         """Accumulated-priority rotation (reference
         `types/validator_set.go:52-69`): each step every validator gains
         accum += power; the max-accum validator (ties: lowest address)
-        becomes proposer and pays total power."""
+        becomes proposer and pays total power.
+
+        Vectorized: the per-step Python max over (accum, sort_key)
+        tuples was ~0.2 ms/block at V=100 — a leading slice of the
+        fast-sync apply stage (VERDICT r4 #5).  numpy argmax decides;
+        the byte-string tie-break only runs on actual accum ties
+        (equal-power sets at specific heights)."""
+        vals = self.validators
+        powers = self._powers_arr()
+        accums = np.fromiter((v.accum for v in vals), np.int64, len(vals))
         for _ in range(times):
-            for v in self.validators:
-                v.accum += v.voting_power
-            proposer = max(self.validators,
-                           key=lambda v: (v.accum, v.sort_key))
-            proposer.accum -= self._total
-            self._proposer = proposer
+            accums += powers
+            i = int(np.argmax(accums))
+            ties = np.flatnonzero(accums == accums[i])
+            if len(ties) > 1:
+                i = max((int(t) for t in ties),
+                        key=lambda t: vals[t].sort_key)
+            accums[i] -= self._total
+            self._proposer = vals[i]
+        for v, a in zip(vals, accums.tolist()):
+            v.accum = a
         self.__dict__.pop("_enc", None)    # accum is part of encode()
 
     @property
@@ -260,6 +280,7 @@ class ValidatorSet:
         self._pubs_mat = None    # the grouped-verify identity + key matrix
         self.__dict__.pop("_hash", None)
         self.__dict__.pop("_enc", None)
+        self.__dict__.pop("_powers", None)
         if (self._proposer is not None and
                 self._proposer.address not in self._by_addr):
             self._proposer = None

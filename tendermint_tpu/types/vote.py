@@ -120,6 +120,41 @@ class _BlockVotes:
             self.sum += power
 
 
+def batch_verify_vote_sigs(chain_id: str, val_set, votes) -> np.ndarray:
+    """ONE grouped signature check for votes by members of `val_set` —
+    the shared lane assembly under both `VoteSet.add_votes_batched` and
+    the consensus receive loop's burst pre-verify.
+
+    Caller guarantees every vote passed `validate_basic` and that
+    `val_set.validators[v.validator_index].address` matches — this
+    function checks signatures only.  Nil-vote hashes are zero-padded to
+    the fixed 32-byte rows `batch_sign_bytes` documents (validate_basic
+    pinned all hash lengths, so the padding matches the scalar writer).
+    Returns bool[N].
+    """
+    from tendermint_tpu.crypto import backend as cb
+    n = len(votes)
+    if n == 0:
+        return np.zeros(0, dtype=bool)
+    msgs = canonical.batch_sign_bytes(
+        chain_id,
+        np.asarray([v.type for v in votes], dtype=np.uint8),
+        np.asarray([v.height for v in votes], dtype=np.uint64),
+        np.asarray([v.round for v in votes], dtype=np.uint32),
+        np.frombuffer(b"".join(v.block_id.hash.ljust(32, b"\x00")
+                               for v in votes), np.uint8).reshape(n, 32),
+        np.frombuffer(b"".join(v.block_id.parts.hash.ljust(32, b"\x00")
+                               for v in votes), np.uint8).reshape(n, 32),
+        np.asarray([v.block_id.parts.total for v in votes],
+                   dtype=np.uint32))
+    return cb.verify_grouped(
+        val_set.set_key(), val_set.pubs_matrix(),
+        np.asarray([v.validator_index for v in votes], dtype=np.int32),
+        msgs,
+        np.frombuffer(b"".join(v.signature for v in votes),
+                      np.uint8).reshape(n, 64))
+
+
 class VoteSet:
     """All votes of one (height, round, type) weighted by validator power
     (reference `types/vote_set.go:46-288`).
@@ -182,10 +217,9 @@ class VoteSet:
     def add_votes_batched(self, votes: list[Vote]) -> list[bool | Exception]:
         """Bulk ingestion: one batched device verify for all signatures,
         then sequential accounting.  Returns per-vote outcome."""
-        from tendermint_tpu.crypto import backend as cb
         if not votes:
             return []
-        idxs, sel, sigs, checkable = [], [], [], []
+        sel, checkable = [], []
         for i, v in enumerate(votes):
             try:
                 v.validate_basic()
@@ -196,34 +230,12 @@ class VoteSet:
                     v.type == self.type and idx < self.size() and
                     self.val_set.validators[idx].address ==
                     v.validator_address):
-                idxs.append(idx)
                 sel.append(v)
-                sigs.append(v.signature)
                 checkable.append(i)
         ok = np.zeros(len(votes), dtype=bool)
         if checkable:
-            n = len(sel)
-            # vectorized sign-bytes assembly (validate_basic pinned hash
-            # lengths, so zero-padding nil hashes matches the scalar
-            # writer) + grouped verify against the set's cached tables
-            msgs = canonical.batch_sign_bytes(
-                self.chain_id,
-                np.full(n, self.type, dtype=np.uint8),
-                np.full(n, self.height, dtype=np.uint64),
-                np.full(n, self.round, dtype=np.uint32),
-                np.frombuffer(
-                    b"".join(v.block_id.hash.ljust(32, b"\x00")
-                             for v in sel), np.uint8).reshape(n, 32),
-                np.frombuffer(
-                    b"".join(v.block_id.parts.hash.ljust(32, b"\x00")
-                             for v in sel), np.uint8).reshape(n, 32),
-                np.asarray([v.block_id.parts.total for v in sel],
-                           dtype=np.uint32))
-            res = cb.verify_grouped(
-                self.val_set.set_key(), self.val_set.pubs_matrix(),
-                np.asarray(idxs, dtype=np.int32), msgs,
-                np.frombuffer(b"".join(sigs), np.uint8).reshape(-1, 64))
-            ok[np.array(checkable)] = res
+            ok[np.array(checkable)] = batch_verify_vote_sigs(
+                self.chain_id, self.val_set, sel)
         out: list[bool | Exception] = []
         for i, v in enumerate(votes):
             if not ok[i]:

@@ -49,11 +49,16 @@ class Gauge:
 
 
 class Summary:
-    """Streaming mean/max with exponential decay toward recent samples."""
-    __slots__ = ("_mean", "_max", "_n", "_lock", "alpha")
+    """Streaming mean/min/max with exponential decay toward recent
+    samples.  `min` matters for breakeven decisions: the first sample of
+    a device-call summary includes the XLA compile, so the mean starts
+    wildly inflated while the min converges to the steady per-call cost
+    after one warm call."""
+    __slots__ = ("_mean", "_min", "_max", "_n", "_lock", "alpha")
 
     def __init__(self, alpha: float = 0.1):
         self._mean = 0.0
+        self._min = 0.0
         self._max = 0.0
         self._n = 0
         self._lock = threading.Lock()
@@ -64,8 +69,11 @@ class Summary:
             self._n += 1
             if self._n == 1:
                 self._mean = v
+                self._min = v
             else:
                 self._mean += self.alpha * (v - self._mean)
+                if v < self._min:
+                    self._min = v
             if v > self._max:
                 self._max = v
 
@@ -76,6 +84,10 @@ class Summary:
     @property
     def count(self) -> int:
         return self._n
+
+    @property
+    def min(self) -> float:
+        return self._min
 
 
 class Registry:
@@ -94,6 +106,9 @@ class Registry:
         self.device_dispatch_seconds = Summary()  # dispatch->result wall
         #   (includes overlapped host work in pipelined callers)
         self.table_build_seconds = Summary()  # comb-table builds (per set)
+        # live-vote micro-batching (receive-loop burst ingestion)
+        self.vote_microbatches = Counter()
+        self.vote_microbatch_lanes = Counter()
         # sync plane
         self.blocks_synced = Counter()
         # p2p plane
@@ -118,6 +133,8 @@ class Registry:
                 round(self.device_step_seconds.mean, 6),
             "device_dispatch_seconds_mean":
                 round(self.device_dispatch_seconds.mean, 6),
+            "vote_microbatches": self.vote_microbatches.value,
+            "vote_microbatch_lanes": self.vote_microbatch_lanes.value,
             "blocks_synced": self.blocks_synced.value,
             "peers": self.peers.value,
             "p2p_msgs_sent": self.msgs_sent.value,

@@ -289,3 +289,95 @@ def test_heartbeat_codec_non_validator_index():
                    height=7, round=2, sequence=3, signature=b"\x05" * 64)
     out = decode_msg(encode_msg(ProposalHeartbeatMessage(hb)))
     assert out.heartbeat == hb
+
+
+def test_playback_console_manager(tmp_path):
+    """Replay-console playback manager (reference
+    `consensus/replay_file.go:76-141`): next/back/run_until drive a
+    fresh ConsensusState from the WAL, and back(n) = reset + re-feed."""
+    from tendermint_tpu.consensus.replay import Playback
+
+    privs, vs = make_validators(1)
+    gen = make_genesis(CHAIN, privs)
+    wal_path = str(tmp_path / "cs.wal")
+    cs, mp, bs = _make_cs(privs[0], gen, wal_path=wal_path)
+    cs.start()
+    assert _wait_height(cs, 3)
+    cs.stop()
+
+    pb = Playback(gen, wal_path, proxy_app="kvstore",
+                  cfg=fast_config().consensus)
+    assert len(pb.records) > 0 and pb.count == 0
+    assert pb.round_state("short").startswith("1/")
+
+    # run until height 2 is fully committed
+    pb.run_until(2)
+    assert pb.cs.block_store.height >= 2
+    assert pb.round_state("short").startswith("3/")
+    mark = pb.count
+
+    # step a few more records forward
+    fed = pb.next(3)
+    assert fed == min(3, len(pb.records) - mark)
+    assert pb.count == mark + fed
+
+    # seek back: state rebuilds from genesis and lands at mark again
+    pb.back(fed)
+    assert pb.count == mark
+    assert pb.cs.block_store.height >= 2
+    assert pb.round_state("short").startswith("3/")
+
+    # back to the very beginning
+    pb.back(pb.count)
+    assert pb.count == 0
+    assert pb.cs.block_store.height == 0
+    # and forward through the whole WAL: ends at the live node's height
+    pb.next(len(pb.records))
+    assert pb.cs.block_store.height >= 3
+
+
+def test_vote_run_microbatch_ingest(tmp_path):
+    """Receive-loop vote micro-batching (SURVEY §7 hard-part 3): a
+    queued burst of >=16 votes is signature-checked in one grouped call,
+    then accounted sequentially — same outcomes as the scalar loop,
+    including rejection of bad signatures and equivocation evidence."""
+    from tendermint_tpu.consensus import messages as M
+    from tendermint_tpu.types import BlockID, PartSetHeader
+    from chainutil import sign_vote
+
+    n_vals = 20
+    privs, vs = make_validators(n_vals)
+    gen = make_genesis(CHAIN, privs)
+    cs, mp, bs = _make_cs(None, gen)   # observer: no own votes
+    cs._replay_mode = True             # no WAL; direct driving
+    cs._enter_new_round(1, 0)
+    bid = BlockID(b"\x11" * 32, PartSetHeader(1, b"\x22" * 32))
+
+    votes = [sign_vote(p, vs, CHAIN, 1, 0, 2, bid) for p in privs]
+    # corrupt one signature; make another an equivocation (second vote
+    # for a different block by the same validator)
+    from dataclasses import replace
+    bad = replace(votes[3], signature=b"\x00" * 64)
+    other_bid = BlockID(b"\x33" * 32, PartSetHeader(1, b"\x44" * 32))
+    # byzantine signer: fresh PrivValidator object over the same key so
+    # the honest HRS double-sign guard does not stop the equivocation
+    byz = PrivValidator(privs[5].priv_key)
+    conflict = sign_vote(byz, vs, CHAIN, 1, 0, 2, other_bid)
+
+    evid = []
+    cs.evsw.subscribe("t", "EvidenceDoubleSign", lambda e: evid.append(e))
+    run = [(M.VoteMessage(v), "peerA") for v in votes[:3]] + \
+          [(M.VoteMessage(bad), "peerB")] + \
+          [(M.VoteMessage(v), "peerA") for v in votes[4:]] + \
+          [(M.VoteMessage(conflict), "peerC")]
+    assert len(run) >= cs.VOTE_MICROBATCH_MIN
+    cs._handle_vote_run(run)
+
+    pc = cs.votes.precommits(0)
+    # all valid votes landed except index 3 (bad signature)
+    got = [pc._votes[i] is not None for i in range(n_vals)]
+    assert got == [i != 3 for i in range(n_vals)]
+    # the equivocation surfaced as evidence, not a crash
+    assert len(evid) == 1
+    # and 2/3+ precommits drove the commit machinery forward
+    assert cs.block_store.height >= 0   # machine still consistent

@@ -18,6 +18,7 @@ from tendermint_tpu.p2p import (AddrBook, ChannelDescriptor, MConnection,
                                 mem_pair)
 from tendermint_tpu.p2p.secret import x25519, x25519_keypair
 from tendermint_tpu.p2p import transport
+from tendermint_tpu.p2p import addrbook as addrbook_mod
 from tendermint_tpu.types.keys import PrivKey
 
 
@@ -306,3 +307,94 @@ def test_pex_exchanges_addresses():
         assert _wait_for(lambda: book2.size() >= 5)
     finally:
         sw1.stop(); sw2.stop()
+
+
+def test_addrbook_new_bucket_eviction_under_pressure():
+    """Flooding one /16 from one source stays bounded by bucket size and
+    evicts randomly WITHIN that bucket (reference addrbook.go expireNew /
+    randomized eviction) — other groups are untouched."""
+    book = AddrBook()
+    keep = NetAddress.parse("tcp://192.168.0.1:26656")
+    book.add_address(keep, "seed.example:26656")
+    # same /16 + same source => one shared new bucket
+    n = 3 * addrbook_mod.BUCKET_SIZE
+    for i in range(n):
+        book.add_address(
+            NetAddress.parse(f"tcp://10.7.{i // 250}.{i % 250 + 1}:26656"),
+            "10.99.0.1:26656")
+    same_group = [e for e in book._entries.values()
+                  if e.addr.host.startswith("10.7.")]
+    assert len(same_group) <= addrbook_mod.BUCKET_SIZE
+    assert book.has(keep)                 # pressure confined to the bucket
+    buckets = {e.bucket for e in same_group}
+    assert len(buckets) == 1              # all landed in one bucket
+
+
+def test_addrbook_eviction_prefers_bad_entries():
+    book = AddrBook()
+    src = "10.99.0.1:26656"
+    addrs = [NetAddress.parse(f"tcp://10.8.0.{i + 1}:26656")
+             for i in range(addrbook_mod.BUCKET_SIZE)]
+    for a in addrs:
+        book.add_address(a, src)
+    # one entry has failed MAX_FAILURES times and never succeeded
+    bad = addrs[7]
+    for _ in range(addrbook_mod.MAX_FAILURES):
+        book.mark_attempt(bad)
+    filler = NetAddress.parse("tcp://10.8.1.1:26656")
+    # same group+src so it maps to the same (now full) bucket
+    assert book.add_address(filler, src)
+    assert not book.has(bad)              # the bad entry was the evictee
+    assert book.has(filler)
+
+
+def test_addrbook_promotion_and_demotion():
+    """mark_good moves new->old; a full old bucket demotes a random old
+    member back to a new bucket (reference moveToOld)."""
+    book = AddrBook()
+    src = "10.99.0.1:26656"
+    n = addrbook_mod.BUCKET_SIZE + 1
+    addrs = [NetAddress.parse(f"tcp://10.9.0.{i + 1}:26656")
+             for i in range(n)]
+    for a in addrs:
+        book.add_address(a, src)
+        book.mark_good(a)                 # all promote to the SAME old
+    ents = [book._entries[a.dial_string()] for a in addrs]
+    olds = [e for e in ents if e.old]
+    news = [e for e in ents if not e.old]
+    assert len(olds) == addrbook_mod.BUCKET_SIZE
+    assert len(news) == 1                 # one demoted back to new
+    # promotion resets the failure counter
+    assert all(e.attempts == 0 for e in olds)
+
+
+def test_addrbook_persistence_roundtrip_property(tmp_path):
+    """Random books survive save/load with status, attempts and
+    timestamps intact (reference JSON dump round-trip)."""
+    import random as _random
+    rng = _random.Random(42)
+    path = str(tmp_path / "book.json")
+    book = AddrBook(path)
+    want = {}
+    for i in range(200):
+        a = NetAddress.parse(
+            f"tcp://10.{rng.randrange(50)}.{rng.randrange(250)}."
+            f"{rng.randrange(1, 250)}:{26656 + rng.randrange(4)}")
+        if not book.add_address(a, f"10.99.0.{rng.randrange(1, 5)}:26656"):
+            continue
+        for _ in range(rng.randrange(3)):
+            book.mark_attempt(a)
+        if rng.random() < 0.4:
+            book.mark_good(a)
+        e = book._entries[a.dial_string()]
+        want[a.dial_string()] = (e.old, e.attempts, e.last_success,
+                                 e.last_attempt)
+    book.save()
+    loaded = AddrBook(path)
+    assert loaded.size() == book.size()
+    for key, (old, attempts, last_s, last_a) in want.items():
+        e = loaded._entries[key]
+        assert (e.old, e.attempts) == (old, attempts), key
+        assert e.last_success == last_s and e.last_attempt == last_a
+    # old/new split survives: picks still work
+    assert loaded.pick_address() is not None

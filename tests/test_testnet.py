@@ -25,26 +25,27 @@ BASE_PORT = 28700
 N = 4
 
 
-def _rpc_port(i: int) -> int:
-    return BASE_PORT + 1 + 2 * i
+def _rpc_port(i: int, base: int = BASE_PORT) -> int:
+    return base + 1 + 2 * i
 
 
-def _rpc(i, method, timeout=2.0):
+def _rpc(i, method, timeout=2.0, base=BASE_PORT):
     with urllib.request.urlopen(
-            f"http://127.0.0.1:{_rpc_port(i)}/{method}", timeout=timeout) as r:
+            f"http://127.0.0.1:{_rpc_port(i, base)}/{method}",
+            timeout=timeout) as r:
         return json.loads(r.read())["result"]
 
 
-def _height(i) -> int:
-    return _rpc(i, "status")["latest_block_height"]
+def _height(i, base=BASE_PORT) -> int:
+    return _rpc(i, "status", base=base)["latest_block_height"]
 
 
-def _wait_heights(idxs, height, timeout=90.0):
+def _wait_heights(idxs, height, timeout=90.0, base=BASE_PORT):
     deadline = time.time() + timeout
     last = {}
     while time.time() < deadline:
         try:
-            last = {i: _height(i) for i in idxs}
+            last = {i: _height(i, base) for i in idxs}
             if all(h >= height for h in last.values()):
                 return last
         except OSError:
@@ -100,6 +101,63 @@ def test_testnet_basic_and_fast_sync_rejoin(tmp_path):
         again = {i: _rpc(i, f"block?height={h}")["block"]["block_hash"]
                  for i in range(N)}
         assert len(set(again.values())) == 1, again
+    finally:
+        for p in procs.values():
+            try:
+                p.kill()
+                p.wait(timeout=10)
+            except Exception:
+                pass
+
+
+@pytest.mark.slow
+def test_testnet_kill_all_recovery(tmp_path):
+    """`kill_all` (reference `test/p2p/README.md:1-30`): run 4 nodes to
+    height >= 5, SIGKILL ALL of them simultaneously (no graceful stop —
+    WAL/store/priv-validator must carry recovery alone), restart all,
+    and assert the chain RESUMES: +3 more heights and identical block
+    and app hashes across every node."""
+    base = 28750
+    out = str(tmp_path / "net")
+    gen = subprocess.run(
+        [sys.executable, "-m", "tendermint_tpu.cli", "testnet",
+         "--n", str(N), "--output", out, "--chain-id", "killall-chain",
+         "--base-port", str(base)],
+        env=ENV, capture_output=True, text=True, cwd=REPO)
+    assert gen.returncode == 0, gen.stdout + gen.stderr
+
+    def start(i):
+        return subprocess.Popen(
+            [sys.executable, "-m", "tendermint_tpu.cli",
+             "--home", os.path.join(out, f"node{i}"), "node",
+             "--crypto-backend", "python"],
+            env=ENV, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            cwd=REPO)
+
+    procs = {i: start(i) for i in range(N)}
+    try:
+        pre = _wait_heights(range(N), 5, base=base)
+        h_mark = min(pre.values())
+
+        # simultaneous SIGKILL of the whole net, mid-consensus
+        for p in procs.values():
+            p.send_signal(signal.SIGKILL)
+        for p in procs.values():
+            p.wait(timeout=10)
+
+        # restart everyone; the chain must resume PAST the kill point
+        procs = {i: start(i) for i in range(N)}
+        final = _wait_heights(range(N), h_mark + 3, timeout=120, base=base)
+        assert all(h >= h_mark + 3 for h in final.values()), final
+
+        # identical history and app state at the kill-spanning heights
+        for h in (h_mark, h_mark + 2):
+            blocks = {i: _rpc(i, f"block?height={h}", base=base)["block"]
+                      for i in range(N)}
+            assert len({b["block_hash"] for b in blocks.values()}) == 1, \
+                (h, blocks)
+            assert len({b["header"]["app_hash"]
+                        for b in blocks.values()}) == 1, h
     finally:
         for p in procs.values():
             try:

@@ -1,0 +1,219 @@
+"""UPnP client tests against a fake in-process gateway.
+
+The reference ships UPnP (reference `p2p/upnp/upnp.go`, `probe.go`) with
+no tests; here a localhost SSDP responder + HTTP control endpoint
+exercise the full discover -> describe -> SOAP round-trip.
+"""
+
+import socket
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from tendermint_tpu.p2p import upnp
+
+ROOT_DESC = """<?xml version="1.0"?>
+<root xmlns="urn:schemas-upnp-org:device-1-0">
+ <device>
+  <deviceType>urn:schemas-upnp-org:device:InternetGatewayDevice:1</deviceType>
+  <deviceList>
+   <device>
+    <deviceType>urn:schemas-upnp-org:device:WANDevice:1</deviceType>
+    <deviceList>
+     <device>
+      <deviceType>urn:schemas-upnp-org:device:WANConnectionDevice:1</deviceType>
+      <serviceList>
+       <service>
+        <serviceType>urn:schemas-upnp-org:service:WANIPConnection:1</serviceType>
+        <controlURL>/ctl/IPConn</controlURL>
+       </service>
+      </serviceList>
+     </device>
+    </deviceList>
+   </device>
+  </deviceList>
+ </device>
+</root>"""
+
+SOAP_EXT_IP = """<?xml version="1.0"?>
+<s:Envelope xmlns:s="http://schemas.xmlsoap.org/soap/envelope/">
+ <s:Body>
+  <u:GetExternalIPAddressResponse
+     xmlns:u="urn:schemas-upnp-org:service:WANIPConnection:1">
+   <NewExternalIPAddress>203.0.113.7</NewExternalIPAddress>
+  </u:GetExternalIPAddressResponse>
+ </s:Body>
+</s:Envelope>"""
+
+SOAP_OK = """<?xml version="1.0"?>
+<s:Envelope xmlns:s="http://schemas.xmlsoap.org/soap/envelope/">
+ <s:Body><u:Resp xmlns:u="urn:schemas-upnp-org:service:WANIPConnection:1"/>
+ </s:Body>
+</s:Envelope>"""
+
+
+class FakeGateway:
+    """SSDP UDP responder + device-description/SOAP HTTP server."""
+
+    def __init__(self):
+        self.mappings: dict[tuple[str, int], int] = {}
+        self.soap_calls: list[str] = []
+        gw = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _send(self, body: bytes, code: int = 200):
+                self.send_response(code)
+                self.send_header("Content-Type", "text/xml")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/rootDesc.xml":
+                    self._send(ROOT_DESC.encode())
+                else:
+                    self._send(b"not found", 404)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", "0"))
+                body = self.rfile.read(n).decode()
+                action = self.headers.get("SOAPAction", "")
+                gw.soap_calls.append(action)
+                if "GetExternalIPAddress" in action:
+                    self._send(SOAP_EXT_IP.encode())
+                elif "AddPortMapping" in action:
+                    port = int(body.split("<NewExternalPort>")[1]
+                               .split("<")[0])
+                    proto = body.split("<NewProtocol>")[1].split("<")[0]
+                    internal = int(body.split("<NewInternalPort>")[1]
+                                   .split("<")[0])
+                    gw.mappings[(proto, port)] = internal
+                    self._send(SOAP_OK.encode())
+                elif "DeletePortMapping" in action:
+                    port = int(body.split("<NewExternalPort>")[1]
+                               .split("<")[0])
+                    proto = body.split("<NewProtocol>")[1].split("<")[0]
+                    if (proto, port) not in gw.mappings:
+                        self._send(b"no such mapping", 500)
+                        return
+                    del gw.mappings[(proto, port)]
+                    self._send(SOAP_OK.encode())
+                else:
+                    self._send(b"unknown action", 500)
+
+        self.http = HTTPServer(("127.0.0.1", 0), Handler)
+        self.http_port = self.http.server_address[1]
+        self.udp = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.udp.bind(("127.0.0.1", 0))
+        self.ssdp_addr = self.udp.getsockname()
+        self._stop = threading.Event()
+        self._threads = [
+            threading.Thread(target=self.http.serve_forever, daemon=True),
+            threading.Thread(target=self._udp_loop, daemon=True),
+        ]
+        for t in self._threads:
+            t.start()
+
+    def _udp_loop(self):
+        self.udp.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                data, addr = self.udp.recvfrom(2048)
+            except socket.timeout:
+                continue
+            if not data.startswith(b"M-SEARCH"):
+                continue
+            resp = ("HTTP/1.1 200 OK\r\n"
+                    "CACHE-CONTROL: max-age=1800\r\n"
+                    "ST: urn:schemas-upnp-org:device:"
+                    "InternetGatewayDevice:1\r\n"
+                    f"LOCATION: http://127.0.0.1:{self.http_port}"
+                    "/rootDesc.xml\r\n\r\n")
+            self.udp.sendto(resp.encode(), addr)
+
+    def close(self):
+        self._stop.set()
+        self.http.shutdown()
+        self.http.server_close()
+        self.udp.close()
+
+
+@pytest.fixture
+def gateway():
+    gw = FakeGateway()
+    yield gw
+    gw.close()
+
+
+def test_discover_finds_gateway(gateway):
+    nat = upnp.discover(timeout=1.0, ssdp_addr=gateway.ssdp_addr)
+    assert nat.service_url.endswith("/ctl/IPConn")
+    assert nat.urn_domain == "schemas-upnp-org"
+    assert nat.our_ip == "127.0.0.1"
+
+
+def test_external_address(gateway):
+    nat = upnp.discover(timeout=1.0, ssdp_addr=gateway.ssdp_addr)
+    assert nat.get_external_address() == "203.0.113.7"
+
+
+def test_port_mapping_roundtrip(gateway):
+    nat = upnp.discover(timeout=1.0, ssdp_addr=gateway.ssdp_addr)
+    got = nat.add_port_mapping("tcp", 26656, 26656, "test", 0)
+    assert got == 26656
+    assert gateway.mappings == {("TCP", 26656): 26656}
+    nat.delete_port_mapping("tcp", 26656)
+    assert gateway.mappings == {}
+
+
+def test_delete_unknown_mapping_raises(gateway):
+    nat = upnp.discover(timeout=1.0, ssdp_addr=gateway.ssdp_addr)
+    with pytest.raises(upnp.UPnPError):
+        nat.delete_port_mapping("tcp", 4242)
+
+
+def test_probe_reports_capabilities(gateway):
+    caps = upnp.probe(int_port=20123, ext_port=20123,
+                      ssdp_addr=gateway.ssdp_addr)
+    assert caps["port_mapping"] is True
+    assert caps["external_ip"] == "203.0.113.7"
+    # mapping was cleaned up after the probe
+    assert gateway.mappings == {}
+    # three SOAP calls: ext-ip, add, delete
+    kinds = [a.split("#")[-1].strip('"') for a in gateway.soap_calls]
+    assert kinds == ["GetExternalIPAddress", "AddPortMapping",
+                     "DeletePortMapping"]
+
+
+def test_discover_no_responder_times_out():
+    # a bound-but-silent UDP port: discovery must raise, not hang
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.bind(("127.0.0.1", 0))
+    try:
+        with pytest.raises(upnp.UPnPError):
+            upnp.discover(timeout=0.3, ssdp_addr=s.getsockname())
+    finally:
+        s.close()
+
+
+def test_external_listener_address(gateway):
+    got = upnp.external_listener_address(26700, ssdp_addr=gateway.ssdp_addr)
+    assert got is not None
+    nat, addr = got
+    assert addr == "203.0.113.7:26700"
+    assert gateway.mappings == {("TCP", 26700): 26700}
+    nat.delete_port_mapping("tcp", 26700)
+
+
+def test_cli_probe_upnp(gateway, monkeypatch, capsys):
+    from tendermint_tpu.cli import main
+    monkeypatch.setattr(upnp, "SSDP_ADDR", gateway.ssdp_addr)
+    rc = main(["probe_upnp", "--int-port", "20321", "--ext-port", "20321"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "Probe success!" in out
+    assert "203.0.113.7" in out
