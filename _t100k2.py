@@ -1,0 +1,4 @@
+import importlib
+b = importlib.import_module("bench")
+res = b._replay_chain(n_vals=100, n_blocks=100_000, backend="tpu", target_lanes=65536, window=625, payload=2048)
+print(res)

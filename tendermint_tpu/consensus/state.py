@@ -387,8 +387,17 @@ class ConsensusState:
         current height/set (last-commit stragglers, future heights) are
         left to the scalar path — so a False here only means "not
         batched", never "rejected"."""
+        from tendermint_tpu.crypto import backend as cb
         from tendermint_tpu.types.vote import batch_verify_vote_sigs
         vals = self.validators
+        be = cb.get_backend()
+        cached = getattr(be, "tables_cached", None)
+        if cached is not None and not cached(vals.set_key()):
+            # a COLD set would pay the multi-second comb-table build
+            # synchronously under the consensus mutex (e.g. right after
+            # a validator-set change) — stay scalar until the background
+            # paths have built the tables
+            return set()
         sel = []
         for v in votes:
             try:

@@ -80,11 +80,16 @@ class TpuBackend:
     """
     name = "tpu"
 
-    # Cached 10-bit comb tables are ~2.5 MB per validator (uint8): 8
-    # full sets of 128 validators is ~2.6 GB of a 16 GB chip's HBM —
-    # sized for a node following one chain plus a light client tracking
-    # a handful of others; raise with care.
-    TABLE_CACHE_SETS = 8
+    # Comb-table cache is BYTE-bounded, not count-bounded: 10-bit tables
+    # are ~2.5 MB per validator (uint8), so a 128-validator set costs
+    # ~312 MB while an 8-validator light chain costs ~41 MB — a count
+    # cap of 8 evicted small light-chain tables whenever a big fast-sync
+    # set was also resident, and the multi-chain streaming loop then
+    # paid full table REBUILDS mid-flight (measured: config4 fell from
+    # 274k to 116k sigs/s when run after config1+3).  4 GB comfortably
+    # holds a validator node's chain plus a light client tracking many
+    # chains on a 16 GB chip.
+    TABLE_CACHE_BYTES = 4 << 30
 
     def __init__(self):
         # import lazily so the python backend works without jax configured
@@ -114,6 +119,13 @@ class TpuBackend:
         if n_dev > 1:
             from tendermint_tpu.parallel import sharding
             self._mesh = sharding.make_mesh(n_dev)
+
+    def tables_cached(self, set_key: bytes) -> bool:
+        """True when the comb tables for `set_key` are already resident —
+        latency-sensitive callers (the consensus receive loop's vote
+        micro-batch) must not trigger a multi-second table build inline."""
+        with self._tables_lock:
+            return set_key in self._tables
 
     def verify_batch(self, pubkeys, msgs, sigs):
         n = len(pubkeys)
@@ -189,8 +201,12 @@ class TpuBackend:
         REGISTRY.table_build_seconds.observe(time.perf_counter() - t0)
         ent = (tbl, ok, v, vp_dev)
         with self._tables_lock:
-            while len(self._tables) >= self.TABLE_CACHE_SETS:
-                self._tables.pop(next(iter(self._tables)))
+            new_bytes = tbl.size                    # uint8: size == bytes
+            resident = sum(e[0].size for e in self._tables.values())
+            while self._tables and \
+                    resident + new_bytes > self.TABLE_CACHE_BYTES:
+                oldest = next(iter(self._tables))   # FIFO eviction
+                resident -= self._tables.pop(oldest)[0].size
             self._tables[set_key] = ent
         return ent
 
@@ -214,14 +230,39 @@ class TpuBackend:
 
         def warm():
             try:
+                # phase 1 (best effort): compile in a SUBPROCESS — two
+                # compiles in one process serialize inside XLA, but a
+                # separate process runs truly concurrent with the main
+                # thread's table-build compile and seeds the shared
+                # persistent cache
+                import json as _json
+                import subprocess
+                import sys as _sys
+                cache_dir = os.environ.get(
+                    "TM_JAX_CACHE_DIR",
+                    os.path.join(os.path.expanduser("~"), ".cache",
+                                 "tendermint_tpu", "jax"))
+                spec = _json.dumps({"kind": kind, "vb": vb,
+                                    "shape": list(shape),
+                                    "cache_dir": cache_dir})
+                try:
+                    subprocess.run(
+                        [_sys.executable, "-m",
+                         "tendermint_tpu.crypto.warmcompile", spec],
+                        capture_output=True, timeout=600)
+                except Exception:
+                    pass
+                # phase 2: dummy call through THIS process's jit cache —
+                # a cache hit from phase 1 loads in seconds; on any
+                # subprocess failure this is the full (fallback) compile
                 ztbl = jnp.zeros((COMB_WINDOWS, COMB_DIGITS, vb, 3, 32),
                                  jnp.uint8)
                 zok = jnp.zeros((vb,), bool)
-                zvp = jnp.zeros((vb, 32), jnp.uint8)
                 if kind == "templated":
                     b, tb, mlen = shape
                     out = self._dev.verify_grouped_templated_jit(
-                        ztbl, zok, zvp, jnp.zeros((b,), jnp.int32),
+                        ztbl, zok, jnp.zeros((vb, 32), jnp.uint8),
+                        jnp.zeros((b,), jnp.int32),
                         jnp.zeros((b,), jnp.int32),
                         jnp.zeros((tb, mlen), jnp.uint8),
                         jnp.zeros((b, 64), jnp.uint8), self._base_tbl)
@@ -346,10 +387,9 @@ class TpuBackend:
                 pubs[i] = np.frombuffer(pubi, np.uint8)
             ent = tuple(self._jnp.asarray(x) for x in (a, pre, pubs))
             with self._tables_lock:
-                # bounded like the comb-table cache: each entry pins three
-                # small device arrays, but rotating fixture sets must not
-                # accumulate forever
-                while len(self._sign_keys) >= self.TABLE_CACHE_SETS:
+                # count-bounded (entries are three tiny device arrays),
+                # but rotating fixture sets must not accumulate forever
+                while len(self._sign_keys) >= 16:
                     self._sign_keys.pop(next(iter(self._sign_keys)))
                 self._sign_keys.setdefault(key, ent)
                 ent = self._sign_keys[key]

@@ -22,6 +22,13 @@ try:
     AVAILABLE = True
 except ImportError:                      # pragma: no cover - env dependent
     AVAILABLE = False
+    import warnings
+    # loud at import, not just at first use: a deploy missing the wheel
+    # must not silently lose the alternative key scheme (every key
+    # operation below also raises RuntimeError)
+    warnings.warn("secp256k1 support disabled: the 'cryptography' "
+                  "package is not installed; ed25519 is unaffected",
+                  RuntimeWarning)
 
 from tendermint_tpu.types.keys import address_from_pubkey
 

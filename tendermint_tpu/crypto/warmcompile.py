@@ -1,0 +1,54 @@
+"""Out-of-process verify-executable warmer.
+
+`TpuBackend._warm_verify_if_cold` spawns this module on a COLD validator
+set so the verify graph's XLA compile runs in a separate process — truly
+concurrent with the main process's comb-table build compile (in-process
+threads serialize inside XLA, measured r5) — and lands in the shared
+persistent compilation cache, which the main process then loads in
+seconds.
+
+Usage: python -m tendermint_tpu.crypto.warmcompile '<json-spec>'
+spec: {"kind": "templated"|"plain", "vb": int, "shape": [..],
+       "cache_dir": str}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def main() -> int:
+    spec = json.loads(sys.argv[1])
+    os.environ["TM_JAX_CACHE_DIR"] = spec["cache_dir"]
+    import jax.numpy as jnp
+    from tendermint_tpu.crypto.backend import _enable_compile_cache
+    from tendermint_tpu.ops import ed25519 as dev
+    from tendermint_tpu.ops.curve import COMB_DIGITS, COMB_WINDOWS, \
+        _base_table
+    _enable_compile_cache()
+    vb = spec["vb"]
+    base_tbl = jnp.asarray(_base_table())
+    ztbl = jnp.zeros((COMB_WINDOWS, COMB_DIGITS, vb, 3, 32), jnp.uint8)
+    zok = jnp.zeros((vb,), bool)
+    if spec["kind"] == "templated":
+        b, tb, mlen = spec["shape"]
+        out = dev.verify_grouped_templated_jit(
+            ztbl, zok, jnp.zeros((vb, 32), jnp.uint8),
+            jnp.zeros((b,), jnp.int32), jnp.zeros((b,), jnp.int32),
+            jnp.zeros((tb, mlen), jnp.uint8),
+            jnp.zeros((b, 64), jnp.uint8), base_tbl)
+    else:
+        b, mlen = spec["shape"]
+        out = dev.verify_grouped_jit(
+            ztbl, zok, jnp.zeros((b,), jnp.int32),
+            jnp.zeros((b, 32), jnp.uint8),
+            jnp.zeros((b, mlen), jnp.uint8),
+            jnp.zeros((b, 64), jnp.uint8), base_tbl)
+    out.block_until_ready()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -114,7 +114,10 @@ class NAT:
         body = (f'<u:GetExternalIPAddress xmlns:u='
                 f'"urn:{self.urn_domain}:service:WANIPConnection:1"/>')
         data = self._soap("GetExternalIPAddress", body)
-        root = ElementTree.fromstring(data)
+        try:
+            root = ElementTree.fromstring(data)
+        except ElementTree.ParseError as e:
+            raise UPnPError(f"malformed SOAP response: {e}") from None
         for el in root.iter():
             if el.tag.endswith("NewExternalIPAddress"):
                 if not el.text:
@@ -161,7 +164,12 @@ def _service_url_from_root(root_url: str) -> tuple[str, str]:
             data = resp.read()
     except OSError as e:
         raise UPnPError(f"device description fetch failed: {e}") from None
-    tree = ElementTree.fromstring(data)
+    try:
+        tree = ElementTree.fromstring(data)
+    except ElementTree.ParseError as e:
+        # a rogue responder's bogus description must not escape the
+        # module's UPnPError contract (probe/CLI/best-effort callers)
+        raise UPnPError(f"malformed device description: {e}") from None
     dev = None
     for el in tree.iter():
         if el.tag.endswith("device"):

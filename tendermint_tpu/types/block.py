@@ -118,6 +118,9 @@ class Commit:
     def size(self) -> int:
         return len(self.precommits)
 
+    def num_sigs(self) -> int:
+        return sum(1 for v in self.precommits if v is not None)
+
     def is_commit(self) -> bool:
         return bool(self.precommits)
 
@@ -171,6 +174,93 @@ class Commit:
 
 
 EMPTY_COMMIT = Commit(block_id=ZERO_BLOCK_ID, precommits=[])
+
+
+@dataclass
+class CompactCommit:
+    """Array-native commit: the device plane's representation.
+
+    A +2/3 commit whose signatures live as ONE uint8[V, 64] matrix with
+    a presence bitmap instead of V `Vote` objects — the form the batched
+    verifier consumes directly (`ValidatorSet.commit_verify_lanes`
+    accepts either).  At fast-sync scale the object form is real cost:
+    100k blocks x 100 validators is 10M Vote objects (~5 GB of heap and
+    tens of seconds of construction) whose fields the verify plane
+    immediately re-flattens into exactly these arrays.  All lanes share
+    the commit's (height, round, block_id) — the common case fast-sync
+    stores; commits with stray foreign/nil votes keep the object form.
+
+    Conversions are lossless both ways for same-block commits; the wire
+    codec stays `Commit` (this is an in-memory/device layout, not a new
+    wire type).
+    """
+    block_id: "BlockID"
+    height_: int
+    round_: int
+    sigs: "object"           # np.uint8[V, 64]
+    present: "object"        # np.bool_[V]
+
+    def height(self) -> int:
+        return self.height_
+
+    def round(self) -> int:
+        return self.round_
+
+    def size(self) -> int:
+        return len(self.present)
+
+    def num_sigs(self) -> int:
+        return int(self.present.sum())
+
+    def is_commit(self) -> bool:
+        return self.num_sigs() > 0
+
+    def bit_array(self) -> list[bool]:
+        return [bool(b) for b in self.present]
+
+    def validate_basic(self) -> None:
+        if self.block_id.is_zero():
+            raise ValueError("commit with zero block id")
+        if self.size() == 0:
+            raise ValueError("commit with no precommits")
+        if self.sigs.shape != (self.size(), 64):
+            raise ValueError("sigs matrix shape mismatch")
+
+    def to_commit(self, val_set) -> Commit:
+        """Expand to the Vote-object form (for wire encoding / stores)."""
+        from tendermint_tpu.types.canonical import TYPE_PRECOMMIT
+        votes: list[Vote | None] = []
+        for i in range(self.size()):
+            if not self.present[i]:
+                votes.append(None)
+                continue
+            votes.append(Vote(
+                validator_address=val_set.validators[i].address,
+                validator_index=i, height=self.height_, round=self.round_,
+                type=TYPE_PRECOMMIT, block_id=self.block_id,
+                signature=self.sigs[i].tobytes()))
+        return Commit(block_id=self.block_id, precommits=votes)
+
+    @classmethod
+    def from_commit(cls, commit: Commit) -> "CompactCommit | None":
+        """Compact a same-block commit; None if any vote targets a
+        different block (foreign/nil strays need the object form)."""
+        import numpy as np
+        n = commit.size()
+        if n == 0:
+            return None
+        key = commit.block_id.key()
+        sigs = np.zeros((n, 64), dtype=np.uint8)
+        present = np.zeros(n, dtype=bool)
+        for i, v in enumerate(commit.precommits):
+            if v is None:
+                continue
+            if v.block_id.key() != key or len(v.signature) != 64:
+                return None
+            sigs[i] = np.frombuffer(v.signature, np.uint8)
+            present[i] = True
+        return cls(block_id=commit.block_id, height_=commit.height(),
+                   round_=commit.round(), sigs=sigs, present=present)
 
 
 @dataclass

@@ -324,6 +324,10 @@ class ValidatorSet:
         vote must not redirect fast-sync blame when the real defect is a
         pruned LastCommit).
         """
+        from tendermint_tpu.types.block import CompactCommit
+        if isinstance(commit, CompactCommit):
+            return self._compact_commit_lanes(chain_id, block_id, height,
+                                              commit)
         if self.size() != commit.size():
             raise ValueError(
                 f"commit size {commit.size()} != valset size {self.size()}")
@@ -376,6 +380,41 @@ class ValidatorSet:
             np.asarray(idxs, dtype=np.int32),
             foreign_power,
         )
+
+    def _compact_commit_lanes(self, chain_id: str, block_id, height: int,
+                              cc) -> tuple:
+        """`commit_verify_lanes` for the array-native `CompactCommit`:
+        the per-vote Python loop collapses to numpy — every present lane
+        shares the commit's (height, round, block_id), so there is ONE
+        template, the sigs matrix slices directly into lanes, and powers
+        come from the cached power array.  Same return contract and the
+        same strictness (shape checks replace per-vote field checks —
+        fixed-width arrays cannot misalign lanes)."""
+        cc.validate_basic()
+        if self.size() != cc.size():
+            raise ValueError(
+                f"commit size {cc.size()} != valset size {self.size()}")
+        if cc.height() != height:
+            raise ValueError(f"commit height {cc.height()} != {height}")
+        tmpl = canonical.sign_bytes(
+            chain_id, canonical.TYPE_PRECOMMIT, height, cc.round(),
+            block_hash=cc.block_id.hash,
+            parts_hash=cc.block_id.parts.hash,
+            parts_total=cc.block_id.parts.total)
+        idxs = np.flatnonzero(cc.present).astype(np.int32)
+        sigs = np.ascontiguousarray(cc.sigs[idxs])
+        n = len(idxs)
+        if cc.block_id.key() == block_id.key():
+            powers = self._powers_arr()[idxs]
+            foreign_power = 0
+        else:   # the whole commit endorses another (or nil) block
+            powers = np.zeros(n, dtype=np.int64)
+            foreign_power = (0 if cc.block_id.is_zero()
+                             else int(self._powers_arr()[idxs].sum()))
+        return (np.frombuffer(tmpl, np.uint8).reshape(
+                    1, canonical.SIGN_BYTES_LEN),
+                np.zeros(n, dtype=np.int32), sigs,
+                powers.astype(np.int64), idxs, foreign_power)
 
     def verify_commit(self, chain_id: str, block_id, height: int,
                       commit) -> None:

@@ -379,5 +379,6 @@ def test_vote_run_microbatch_ingest(tmp_path):
     assert got == [i != 3 for i in range(n_vals)]
     # the equivocation surfaced as evidence, not a crash
     assert len(evid) == 1
-    # and 2/3+ precommits drove the commit machinery forward
-    assert cs.block_store.height >= 0   # machine still consistent
+    # and the accounted precommits formed the +2/3 majority for bid
+    maj = pc.two_thirds_majority()
+    assert maj is not None and maj.hash == bid.hash

@@ -161,3 +161,32 @@ def test_backend_templated_matches_plain():
                              templates[tmpl_idx], sa)
     assert got.tolist() == want.tolist()
     assert not got[4] and got[5]
+
+
+def test_table_cache_byte_bounded_keeps_small_sets():
+    """Regression for the multi-chain churn: one big validator set plus
+    many small light-chain sets must ALL stay resident (the old count
+    bound of 8 evicted small tables whenever big ones rotated in, and
+    the streaming loop then paid full rebuilds mid-flight)."""
+    import numpy as np
+    from tendermint_tpu.crypto import pure_ed25519 as ref
+    from tendermint_tpu.crypto.backend import TpuBackend
+
+    be = TpuBackend()
+    sigs = np.zeros((4, 64), np.uint8)
+    msgs = np.zeros((4, 128), np.uint8)
+    idx = np.zeros(4, np.int32)
+
+    def pubs(tag, n):
+        return np.frombuffer(
+            b"".join(ref.pubkey_from_seed(bytes([tag, i + 1]) + b"\x00" * 30)
+                     for i in range(n)), np.uint8).reshape(n, 32)
+
+    # 10 small sets + 1 bigger set: > the old count cap of 8
+    for tag in range(10):
+        be.verify_grouped(b"small-%d" % tag, pubs(tag + 1, 2), idx,
+                          msgs, sigs)
+    be.verify_grouped(b"big-one", pubs(99, 16), idx, msgs, sigs)
+    assert len(be._tables) == 11          # nothing evicted: all fit 4 GB
+    total = sum(e[0].size for e in be._tables.values())
+    assert total <= be.TABLE_CACHE_BYTES
