@@ -21,7 +21,11 @@ class BaseConfig:
     fast_sync: bool = True
     db_backend: str = "sqlite"           # sqlite | memdb
     log_level: str = "info"
-    crypto_backend: str = "tpu"          # tpu | python | native
+    # tpu | python | native; TM_CRYPTO_BACKEND env overrides the default
+    # (same knob `crypto.backend.get_backend` honors standalone) — a
+    # config-file value or --crypto-backend flag still wins over both
+    crypto_backend: str = field(
+        default_factory=lambda: os.environ.get("TM_CRYPTO_BACKEND", "tpu"))
 
     def root(self) -> str:
         return os.path.expanduser(self.home)

@@ -283,7 +283,13 @@ class ConsensusState:
         from tendermint_tpu.crypto import backend as cb
         be = cb.get_backend()
         if getattr(be, "name", "") != "tpu":
-            return self.VOTE_MICROBATCH_MIN
+            # ONLY the device backend batches: the scalar arrival path
+            # verifies through the NATIVE one-shot primitive (~0.15 ms),
+            # so routing a run through e.g. the python backend's grouped
+            # loop (~3 ms/sig pure bigint) would slow the serialized
+            # consensus loop ~20x — observed as a wedged node in the
+            # GIL-load stress tier when this returned the static floor
+            return 1 << 30
         step = REGISTRY.device_step_seconds
         if step.count < 2:
             # fewer than two device calls seen: the only sample (if any)
@@ -321,10 +327,10 @@ class ConsensusState:
                        isinstance(batch[j][0], M.VoteMessage)):
                     j += 1
                 try:
-                    with self._mtx:
-                        if j > i:
-                            self._handle_vote_run(batch[i:j])
-                        else:
+                    if j > i:
+                        self._handle_vote_run(batch[i:j])
+                    else:
+                        with self._mtx:
                             self._dispatch_one(batch[i])
                 except Exception:
                     # the receive loop must never die; reference recovers
@@ -350,18 +356,29 @@ class ConsensusState:
                     self.wal.save_message(M.encode_msg(msg))
             self._handle_msg(msg, peer_id)
 
+    # accounting chunk per mutex acquisition: gossip routines snapshot
+    # the round state under the same lock, so a multi-thousand-vote run
+    # held under ONE acquisition would starve them for its whole length
+    _VOTE_CHUNK_PER_LOCK = 64
+
     def _handle_vote_run(self, run: list) -> None:
-        """A consecutive run of VoteMessages: WAL each in logical order,
-        batch-verify the signatures when the run is long enough, then do
-        the per-vote accounting and state transitions IN ORDER — the
-        transitions see exactly the same sequence a scalar loop would,
-        so WAL replay (which feeds records one at a time) reconstructs
-        identical state.  The pre-verify mutates nothing, so each vote
-        is still WAL-saved immediately before ITS handling — the exact
-        save/handle interleave of the scalar loop (ENDHEIGHT markers
-        land between the right records).  Replaces the reference's
-        strictly per-vote verify at `types/vote_set.go:175` on the
-        arrival path."""
+        """A consecutive run of VoteMessages: batch-verify the
+        signatures when the run is long enough, then do the per-vote
+        accounting and state transitions IN ORDER — the transitions see
+        exactly the same sequence a scalar loop would, so WAL replay
+        (which feeds records one at a time) reconstructs identical
+        state.  Each vote is WAL-saved immediately before ITS handling —
+        the exact save/handle interleave of the scalar loop (ENDHEIGHT
+        markers land between the right records).
+
+        Locking: the pre-verify runs OUTSIDE self._mtx — it mutates
+        nothing, and consensus state is only ever mutated by THIS thread
+        (the serialized core), so nothing can move under it; votes the
+        accounting below obsoletes (height advanced mid-run) simply fall
+        through to the scalar checks.  Accounting then takes the mutex
+        in short chunks so gossip round-state snapshots interleave.
+        Replaces the reference's strictly per-vote verify at
+        `types/vote_set.go:175` on the arrival path."""
         pre: set[int] = set()
         if len(run) >= self._microbatch_threshold():
             try:
@@ -369,17 +386,19 @@ class ConsensusState:
             except Exception:
                 log.exception("vote micro-batch verify failed; "
                               "falling back to scalar")
-        for msg, peer_id in run:
-            if self.wal is not None and not self._replay_mode:
-                self.wal.save_message(M.encode_msg(msg))
-            try:
-                self._try_add_vote(msg.vote, peer_id,
-                                   preverified=id(msg.vote) in pre)
-            except ErrVoteConflict as e:
-                self.evsw.fire("EvidenceDoubleSign", e.evidence)
-            except Exception:
-                log.exception("error handling vote",
-                              height=self.height, round=self.round)
+        for c in range(0, len(run), self._VOTE_CHUNK_PER_LOCK):
+            with self._mtx:
+                for msg, peer_id in run[c:c + self._VOTE_CHUNK_PER_LOCK]:
+                    if self.wal is not None and not self._replay_mode:
+                        self.wal.save_message(M.encode_msg(msg))
+                    try:
+                        self._try_add_vote(msg.vote, peer_id,
+                                           preverified=id(msg.vote) in pre)
+                    except ErrVoteConflict as e:
+                        self.evsw.fire("EvidenceDoubleSign", e.evidence)
+                    except Exception:
+                        log.exception("error handling vote",
+                                      height=self.height, round=self.round)
 
     def _batch_preverify(self, votes: list) -> set[int]:
         """One grouped signature check for the current-height votes of a

@@ -291,16 +291,47 @@ class TpuBackend:
         return self.verify_grouped_templated_async(
             set_key, val_pubs, val_idx, tmpl_idx, templates, sigs)()
 
+    def prefetch_grouped_lanes(self, val_idx, tmpl_idx, templates, sigs):
+        """Pad lanes/templates to THIS backend's buckets and start the
+        async host->device copies — for pipeline prep stages that want
+        the multi-MB transfer riding the link while they keep hashing.
+        Returns (val_idx, tmpl_idx, templates, sigs, real_n): device
+        arrays plus the REAL lane count to pass back through
+        `verify_grouped_templated_async(real_n=...)` so telemetry and
+        the result trim stay keyed to real lanes, not padding."""
+        import jax
+        n = len(val_idx)
+        b = _bucket(n)
+        val_idx = np.asarray(val_idx, np.int32)
+        tmpl_idx = np.asarray(tmpl_idx, np.int32)
+        if b > n:
+            val_idx = np.concatenate(
+                [val_idx, np.repeat(val_idx[:1], b - n)])
+            tmpl_idx = np.concatenate(
+                [tmpl_idx, np.repeat(tmpl_idx[:1], b - n)])
+            sigs = np.concatenate([sigs, np.repeat(sigs[:1], b - n, 0)])
+        t = len(templates)
+        tb = _bucket(t)
+        if tb > t:
+            templates = np.concatenate(
+                [templates,
+                 np.zeros((tb - t, templates.shape[1]), np.uint8)])
+        return (jax.device_put(val_idx), jax.device_put(tmpl_idx),
+                jax.device_put(templates), jax.device_put(sigs), n)
+
     def verify_grouped_templated_async(self, set_key, val_pubs, val_idx,
-                                       tmpl_idx, templates, sigs):
+                                       tmpl_idx, templates, sigs,
+                                       real_n: int | None = None):
         """Dispatching half of `verify_grouped_templated`: uploads the
         lanes and queues the device step WITHOUT waiting, returning a
         zero-arg closure that blocks for the result.  A pipeline caller
         dispatches window k+1 before collecting window k, so the
         multi-MB lane upload (the dominant per-window cost over a slow
         host<->device link) overlaps the previous window's compute.
+        `real_n` marks inputs pre-padded by `prefetch_grouped_lanes`
+        (result trims and metrics key to it, not the padded length).
         """
-        n = len(val_idx)
+        n = real_n if real_n is not None else len(val_idx)
         if n == 0:
             return lambda: np.zeros(0, dtype=bool)
         warm = self._warm_verify_if_cold(
@@ -317,11 +348,14 @@ class TpuBackend:
         if self._mesh_eligible(b):
             # mesh path: assemble messages host-side and ride the
             # sharded kernel (templates are tiny; the win is moot there)
-            out = self.verify_grouped(set_key, val_pubs, val_idx,
-                                      templates[tmpl_idx], sigs)
+            out = self.verify_grouped(set_key, val_pubs,
+                                      np.asarray(val_idx)[:n],
+                                      np.asarray(templates)[
+                                          np.asarray(tmpl_idx)[:n]],
+                                      np.asarray(sigs)[:n])
             return lambda: out
-        pad = b - n
-        if pad:
+        pad = b - len(val_idx)          # 0 for prefetched inputs
+        if pad > 0:
             val_idx = np.concatenate([val_idx, np.repeat(val_idx[:1], pad)])
             tmpl_idx = np.concatenate([tmpl_idx,
                                        np.repeat(tmpl_idx[:1], pad)])
@@ -551,6 +585,11 @@ def register(name: str, factory) -> None:
 
 def set_backend(name: str) -> Backend:
     global _current
+    if name not in _BACKENDS:
+        # the name may arrive from TM_CRYPTO_BACKEND or a config file —
+        # fail with the valid choices, not a bare KeyError at node boot
+        raise ValueError(f"unknown crypto backend {name!r}; "
+                         f"known: {sorted(_BACKENDS)}")
     with _lock:
         _current = _BACKENDS[name]()
     return _current
@@ -605,14 +644,17 @@ def verify_grouped_templated(set_key: bytes, val_pubs, val_idx, tmpl_idx,
 
 
 def verify_grouped_templated_async(set_key: bytes, val_pubs, val_idx,
-                                   tmpl_idx, templates, sigs):
+                                   tmpl_idx, templates, sigs,
+                                   real_n: int | None = None):
     """Pipelined form: dispatch now, collect via the returned closure.
     Backends without async dispatch run synchronously and hand back the
-    finished result."""
+    finished result.  `real_n` marks inputs pre-padded by the backend's
+    `prefetch_grouped_lanes` (no-op for backends without it)."""
     be = get_backend()
     fn = getattr(be, "verify_grouped_templated_async", None)
     if fn is not None:
-        return fn(set_key, val_pubs, val_idx, tmpl_idx, templates, sigs)
+        return fn(set_key, val_pubs, val_idx, tmpl_idx, templates, sigs,
+                  real_n=real_n)
     out = verify_grouped_templated(set_key, val_pubs, val_idx, tmpl_idx,
                                    templates, sigs)
     return lambda: out

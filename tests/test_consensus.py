@@ -371,6 +371,10 @@ def test_vote_run_microbatch_ingest(tmp_path):
           [(M.VoteMessage(v), "peerA") for v in votes[4:]] + \
           [(M.VoteMessage(conflict), "peerC")]
     assert len(run) >= cs.VOTE_MICROBATCH_MIN
+    # the threshold gate only batches on the device backend (a grouped
+    # python-backend verify would be slower than scalar); force it open
+    # so this test exercises the batch path itself
+    cs._microbatch_threshold = lambda: cs.VOTE_MICROBATCH_MIN
     cs._handle_vote_run(run)
 
     pc = cs.votes.precommits(0)
