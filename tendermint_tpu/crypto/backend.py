@@ -177,6 +177,31 @@ class TpuBackend:
                 self._builds.pop(set_key).set()
         return ent
 
+    # bump when the comb-table layout changes (COMB_WBITS, packing, …):
+    # a versioned filename turns stale-format cache files into misses
+    TABLE_CACHE_FORMAT = 1
+    TABLE_DISK_CACHE_BYTES = 8 << 30     # on-disk cap, oldest-mtime evicted
+
+    @classmethod
+    def _table_cache_path(cls, set_key: bytes) -> str | None:
+        """Disk location for a set's built comb tables, or None when the
+        on-disk cache is disabled (TM_TABLE_CACHE_DIR=\"\").  Tables are
+        pure functions of the member pubkeys and set_key digests those,
+        so content-addressing by set_key can never serve STALE tables.
+        TRUST: the cache dir must be exactly as trusted as the jax
+        persistent compile cache next to it — anyone who can write
+        either can subvert verification (poisoned executables in the
+        compile cache are strictly worse), so both live under the same
+        operator-owned ~/.cache root by default."""
+        d = os.environ.get(
+            "TM_TABLE_CACHE_DIR",
+            os.path.join(os.path.expanduser("~"), ".cache",
+                         "tendermint_tpu", "tables"))
+        if not d:
+            return None
+        return os.path.join(
+            d, f"v{cls.TABLE_CACHE_FORMAT}-{set_key.hex()}.npz")
+
     def _build_tables(self, set_key: bytes, val_pubs: np.ndarray) -> tuple:
         v = len(val_pubs)
         vb = _bucket(v)
@@ -184,8 +209,30 @@ class TpuBackend:
             val_pubs = np.concatenate(
                 [val_pubs, np.repeat(val_pubs[:1], vb - v, 0)])
         t0 = time.perf_counter()
+        path = self._table_cache_path(set_key)
+        from tendermint_tpu.ops.curve import COMB_DIGITS, COMB_WINDOWS
+        want_shape = (COMB_WINDOWS, COMB_DIGITS, vb, 3, 32)
+        import hashlib as _hashlib
+        pubs_digest = _hashlib.sha256(val_pubs.tobytes()).digest()
+        tbl = ok = None
+        if path is not None and os.path.exists(path):
+            try:
+                # loading ~2.5 MB/validator from disk beats the ~12s
+                # on-device rebuild a warm node restart would otherwise
+                # pay; shape + pubs-digest checks turn format drift or a
+                # mislabeled file into a miss (consistency, not a
+                # security boundary — see _table_cache_path)
+                with np.load(path) as z:
+                    if (tuple(z["tbl"].shape) == want_shape and
+                            z["pubs_sha256"].tobytes() == pubs_digest):
+                        tbl = self._jnp.asarray(z["tbl"])
+                        ok = self._jnp.asarray(z["ok"])
+            except Exception:
+                tbl = ok = None          # corrupt cache file: rebuild
         vp_dev = self._jnp.asarray(val_pubs)   # one upload serves both the
-        tbl, ok = self._dev.build_neg_comb_jit(vp_dev)  # build and lane
+        built = tbl is None
+        if built:
+            tbl, ok = self._dev.build_neg_comb_jit(vp_dev)  # build + lane
         if self._mesh is not None:             # pubkey gathers
             # commit the tables replicated across the mesh at build time:
             # the sharded verify takes them as arguments (one jitted fn
@@ -198,7 +245,23 @@ class TpuBackend:
             ok = jax.device_put(ok, repl)
             vp_dev = jax.device_put(vp_dev, repl)
         tbl.block_until_ready()
-        REGISTRY.table_build_seconds.observe(time.perf_counter() - t0)
+        if built:
+            # loads are ~100ms and would drag the build histogram down
+            REGISTRY.table_build_seconds.observe(time.perf_counter() - t0)
+        if built and path is not None:
+            try:                         # persist for the next restart
+                d = os.path.dirname(path)
+                os.makedirs(d, exist_ok=True)
+                tmp = f"{path}.{os.getpid()}.tmp"   # concurrent writers
+                with open(tmp, "wb") as f:   # file object: savez must
+                    np.savez(f, tbl=np.asarray(tbl),  # not append .npz
+                             ok=np.asarray(ok),
+                             pubs_sha256=np.frombuffer(pubs_digest,
+                                                       np.uint8))
+                os.replace(tmp, path)
+                self._prune_table_cache(d)
+            except Exception:
+                pass                     # cache write is best-effort
         ent = (tbl, ok, v, vp_dev)
         with self._tables_lock:
             new_bytes = tbl.size                    # uint8: size == bytes
@@ -209,6 +272,28 @@ class TpuBackend:
                 resident -= self._tables.pop(oldest)[0].size
             self._tables[set_key] = ent
         return ent
+
+    @classmethod
+    def _prune_table_cache(cls, d: str) -> None:
+        """Oldest-mtime eviction past TABLE_DISK_CACHE_BYTES — the disk
+        mirror of the in-memory byte bound (validator-set rotation or a
+        many-chain light client must not fill the disk)."""
+        try:
+            entries = []
+            for name in os.listdir(d):
+                if not name.endswith(".npz"):
+                    continue
+                p = os.path.join(d, name)
+                st = os.stat(p)
+                entries.append((st.st_mtime, st.st_size, p))
+            total = sum(e[1] for e in entries)
+            entries.sort()
+            while entries and total > cls.TABLE_DISK_CACHE_BYTES:
+                mtime, size, p = entries.pop(0)
+                os.unlink(p)
+                total -= size
+        except OSError:
+            pass
 
     def _warm_verify_if_cold(self, set_key: bytes, n_vals: int,
                              kind: str, shape: tuple):

@@ -15,6 +15,7 @@ if "xla_force_host_platform_device_count" not in _flags:
 os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402
+import pytest  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
@@ -23,3 +24,12 @@ jax.config.update("jax_platforms", "cpu")
 _cache_dir = os.path.join(os.path.dirname(__file__), ".jax_cache")
 jax.config.update("jax_compilation_cache_dir", _cache_dir)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
+@pytest.fixture(autouse=True)
+def _isolate_table_disk_cache(tmp_path, monkeypatch):
+    """Every test gets a private comb-table disk cache: without this,
+    tests would persist tables into the developer's real ~/.cache and
+    later runs could verify against STALE tables whenever a test changes
+    its key generation under an unchanged set_key label."""
+    monkeypatch.setenv("TM_TABLE_CACHE_DIR", str(tmp_path / "_tblcache"))

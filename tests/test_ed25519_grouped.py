@@ -190,3 +190,44 @@ def test_table_cache_byte_bounded_keeps_small_sets():
     assert len(be._tables) == 11          # nothing evicted: all fit 4 GB
     total = sum(e[0].size for e in be._tables.values())
     assert total <= be.TABLE_CACHE_BYTES
+
+
+def test_table_disk_cache_roundtrip(tmp_path, monkeypatch):
+    """Disk-persisted comb tables: a fresh backend instance loads the
+    tables a previous one built (content-addressed by set_key) and
+    verifies identically — the warm node-restart path that skips the
+    multi-second on-device rebuild."""
+    import numpy as np
+    from tendermint_tpu.crypto import native
+    from tendermint_tpu.crypto import pure_ed25519 as ref
+    from tendermint_tpu.crypto.backend import TpuBackend
+
+    monkeypatch.setenv("TM_TABLE_CACHE_DIR", str(tmp_path / "tables"))
+    seeds = [bytes([7, i + 1]) + b"\x00" * 30 for i in range(4)]
+    pubs = np.frombuffer(
+        b"".join(ref.pubkey_from_seed(s) for s in seeds),
+        np.uint8).reshape(4, 32)
+    msg = b"m" * 128
+    sig = (native.sign_one(seeds[1], msg) if native.AVAILABLE
+           else ref.sign(seeds[1], msg))
+    idx = np.array([1], np.int32)
+    msgs = np.frombuffer(msg, np.uint8).reshape(1, 128)
+    sigs = np.frombuffer(sig, np.uint8).reshape(1, 64)
+
+    be1 = TpuBackend()
+    assert be1.verify_grouped(b"disk-set", pubs, idx, msgs, sigs).all()
+    files = list((tmp_path / "tables").iterdir())
+    assert len(files) == 1 and files[0].suffix == ".npz"
+
+    be2 = TpuBackend()          # fresh instance: must LOAD, not rebuild
+    assert not be2.tables_cached(b"disk-set")
+    assert be2.verify_grouped(b"disk-set", pubs, idx, msgs, sigs).all()
+    assert be2.tables_cached(b"disk-set")
+    # tampered signature still rejected through the loaded tables
+    bad = sigs.copy(); bad[0, 0] ^= 1
+    assert not be2.verify_grouped(b"disk-set", pubs, idx, msgs, bad).any()
+
+    # corrupt cache file: silently rebuilt, not fatal
+    files[0].write_bytes(b"garbage")
+    be3 = TpuBackend()
+    assert be3.verify_grouped(b"disk-set", pubs, idx, msgs, sigs).all()
