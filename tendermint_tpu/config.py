@@ -83,18 +83,44 @@ class ConsensusConfig:
     timeout_precommit_delta: float = 0.5
     timeout_commit: float = 1.0
     skip_timeout_commit: bool = False
+    # Multiplicative per-round timeout growth on top of the reference's
+    # linear deltas (reference config/config.go:365-381 grows linearly
+    # only; growth 1.0 = exact reference behavior).  When the transport
+    # or scheduler delay that kills rounds is unknown a priori, linear
+    # growth needs delay/delta rounds to catch up, each costing a full
+    # failed round; a factor > 1 overtakes ANY bounded delay in
+    # O(log(delay)) rounds.  Off by default; the scheduler-sabotage
+    # stress tier enables it.
+    timeout_round_growth: float = 1.0
+    timeout_max: float = 30.0            # cap for the exponential form
     max_block_size_txs: int = 10_000
     create_empty_blocks: bool = True
     create_empty_blocks_interval: float = 0.0
 
+    def _grown(self, base: float, delta: float, round_: int) -> float:
+        t = base + delta * round_
+        g = self.timeout_round_growth
+        if g > 1.0:
+            # growth^round overflows float for round ~1750 at g=1.5; the
+            # cap is reached long before that, so clamp the exponent to
+            # the first round where base*g^r alone exceeds the cap
+            import math
+            max_r = math.ceil(math.log(max(self.timeout_max / base, 1.0),
+                                       g)) + 1
+            t = min(t * g ** min(round_, max_r), self.timeout_max)
+        return t
+
     def propose_timeout(self, round_: int) -> float:
-        return self.timeout_propose + self.timeout_propose_delta * round_
+        return self._grown(self.timeout_propose,
+                           self.timeout_propose_delta, round_)
 
     def prevote_timeout(self, round_: int) -> float:
-        return self.timeout_prevote + self.timeout_prevote_delta * round_
+        return self._grown(self.timeout_prevote,
+                           self.timeout_prevote_delta, round_)
 
     def precommit_timeout(self, round_: int) -> float:
-        return self.timeout_precommit + self.timeout_precommit_delta * round_
+        return self._grown(self.timeout_precommit,
+                           self.timeout_precommit_delta, round_)
 
 
 @dataclass

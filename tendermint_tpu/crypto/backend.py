@@ -223,10 +223,11 @@ class TpuBackend:
                 # mislabeled file into a miss (consistency, not a
                 # security boundary — see _table_cache_path)
                 with np.load(path) as z:
-                    if (tuple(z["tbl"].shape) == want_shape and
-                            z["pubs_sha256"].tobytes() == pubs_digest):
-                        tbl = self._jnp.asarray(z["tbl"])
-                        ok = self._jnp.asarray(z["ok"])
+                    if z["pubs_sha256"].tobytes() == pubs_digest:
+                        arr = z["tbl"]   # NpzFile re-reads per access:
+                        if tuple(arr.shape) == want_shape:  # bind once
+                            tbl = self._jnp.asarray(arr)
+                            ok = self._jnp.asarray(z["ok"])
             except Exception:
                 tbl = ok = None          # corrupt cache file: rebuild
         vp_dev = self._jnp.asarray(val_pubs)   # one upload serves both the
@@ -249,6 +250,7 @@ class TpuBackend:
             # loads are ~100ms and would drag the build histogram down
             REGISTRY.table_build_seconds.observe(time.perf_counter() - t0)
         if built and path is not None:
+            tmp = None
             try:                         # persist for the next restart
                 d = os.path.dirname(path)
                 os.makedirs(d, exist_ok=True)
@@ -260,8 +262,12 @@ class TpuBackend:
                                                        np.uint8))
                 os.replace(tmp, path)
                 self._prune_table_cache(d)
-            except Exception:
-                pass                     # cache write is best-effort
+            except Exception:            # cache write is best-effort —
+                if tmp is not None:      # but a half-written tmp (full
+                    try:                 # disk) must not sit outside
+                        os.unlink(tmp)   # the pruner's *.npz scope
+                    except OSError:      # forever
+                        pass
         ent = (tbl, ok, v, vp_dev)
         with self._tables_lock:
             new_bytes = tbl.size                    # uint8: size == bytes

@@ -386,3 +386,28 @@ def test_vote_run_microbatch_ingest(tmp_path):
     # and the accounted precommits formed the +2/3 majority for bid
     maj = pc.two_thirds_majority()
     assert maj is not None and maj.hash == bid.hash
+
+
+def test_timeout_round_growth_config():
+    """`timeout_round_growth` (off by default = reference-linear
+    config/config.go:365-381; exponential when > 1, capped at
+    timeout_max) — the stress tier's lever against scheduler-noise
+    round churn."""
+    from tendermint_tpu.config import ConsensusConfig
+    c = ConsensusConfig()
+    # default: exactly the reference's linear form
+    assert c.timeout_round_growth == 1.0
+    assert c.propose_timeout(0) == c.timeout_propose
+    assert c.propose_timeout(4) == pytest.approx(
+        c.timeout_propose + 4 * c.timeout_propose_delta)
+    # exponential: linear form times growth^round, capped
+    c.timeout_propose, c.timeout_propose_delta = 0.1, 0.15
+    c.timeout_round_growth, c.timeout_max = 1.5, 8.0
+    assert c.propose_timeout(0) == pytest.approx(c.timeout_propose)
+    assert c.propose_timeout(3) == pytest.approx(
+        (0.1 + 3 * 0.15) * 1.5 ** 3)
+    assert c.propose_timeout(50) == 8.0
+    # monotone non-decreasing over rounds (ticker correctness relies on
+    # later rounds never having SHORTER timeouts)
+    seq = [c.propose_timeout(r) for r in range(30)]
+    assert all(b >= a for a, b in zip(seq, seq[1:]))

@@ -28,24 +28,28 @@ WAIT = float(os.environ.get("STRESS_WAIT", "60"))
 
 
 def stress_config():
-    """test_config with the REFERENCE's timeout-growth ratio restored.
+    """test_config with exponential round-timeout growth enabled.
 
-    Under deliberate GIL sabotage on a small box, proposal propagation
+    Under deliberate GIL sabotage on a 1-core box, proposal propagation
     latency can exceed `timeout_propose` every round: all four nodes
-    then churn full nil-vote rounds (observed to round 23+ in 70s with
-    the fast config's 20ms deltas — each round's timeout grew slower
-    than the scheduler noise it had to absorb).  The reference heals
-    exactly this via round growth: its deltas are 500ms on a 3s base
-    (`config/config.go:365-371`), i.e. +17%/round.  This tier keeps the
-    fast 100ms base so healthy rounds stay quick, but grows failed
-    rounds at the reference's ABSOLUTE-margin class so a loaded
-    scheduler converges within a few rounds instead of dozens.  What
-    the tier verifies is liveness — no wedge, no unbounded churn — not
-    sub-second rounds under sabotage."""
+    then churn full-participation nil rounds (state dump from a failing
+    rep: every node at (h=2, r=9), 4/4 prevotes+precommits in rounds
+    0..8, two nodes locked on round 9's block — pure churn, no wedge).
+    Linear deltas need `delay/delta` failed rounds to overtake the
+    scheduler noise, and each failed round costs seconds of wall clock;
+    with a variable-magnitude saboteur that race is unwinnable at any
+    fixed delta.  `timeout_round_growth` > 1 overtakes ANY bounded
+    delay in O(log) rounds, so the tier converges deterministically
+    while still catching real wedges (a wedged node never commits no
+    matter how long its timeouts grow).  What the tier verifies is
+    liveness — no wedge, no unbounded churn — not sub-second rounds
+    under sabotage."""
     c = test_config()
     c.consensus.timeout_propose_delta = 0.15
     c.consensus.timeout_prevote_delta = 0.08
     c.consensus.timeout_precommit_delta = 0.08
+    c.consensus.timeout_round_growth = 1.5
+    c.consensus.timeout_max = 8.0
     return c
 
 
