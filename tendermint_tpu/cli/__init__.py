@@ -70,6 +70,36 @@ def cmd_init(args) -> int:
         save_config_file(cfg, cf)
         print(f"config written to {cf}")
     print(f"priv validator at {pv_file} ({pv.address.hex()})")
+    if getattr(args, "warm_crypto", False):
+        _warm_crypto(cfg)
+    return 0
+
+
+def _warm_crypto(cfg) -> int:
+    """Pre-seed the persistent XLA compile cache + on-disk comb tables
+    for this home's genesis validator set, so the node's FIRST boot is
+    already warm (node boot also warms, but in a background thread —
+    `node/node.py _maybe_precompile` — so a cold first boot verifies its
+    first commits on the fallback backend; seeding at init moves the
+    one-time compile wait to the operator's init step, VERDICT r4 #3).
+    Harmless no-op on the python/native backends."""
+    import time
+    from tendermint_tpu.crypto import backend as cb
+    from tendermint_tpu.types import GenesisDoc
+    # warm the backend the HOME is configured to run, not whatever the
+    # ambient env default selects (node boot does the same, node.py:46)
+    be = cb.set_backend(cfg.base.crypto_backend)
+    if not hasattr(be, "precompile_for_validators"):
+        print(f"crypto backend {cfg.base.crypto_backend!r} has no device "
+              "plane; nothing to warm")
+        return 0
+    doc = GenesisDoc.load(cfg.base.genesis_file())
+    vals = doc.validator_set()
+    t0 = time.time()
+    print(f"warming crypto plane for {vals.size()} validators "
+          f"(one-time; lands in the persistent caches)...", flush=True)
+    be.precompile_for_validators(vals)
+    print(f"crypto warm done in {time.time() - t0:.1f}s")
     return 0
 
 
@@ -326,6 +356,11 @@ def main(argv=None) -> int:
 
     sp = sub.add_parser("init", help="initialize home dir")
     sp.add_argument("--chain-id", default="")
+    sp.add_argument("--warm-crypto", dest="warm_crypto",
+                    action="store_true",
+                    help="pre-seed the XLA compile cache + comb tables "
+                         "for the genesis validator set (one-time; makes "
+                         "the first node boot verify-warm)")
     sp.set_defaults(fn=cmd_init)
 
     sp = sub.add_parser("node", help="run the node")

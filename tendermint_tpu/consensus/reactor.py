@@ -150,11 +150,19 @@ class PeerState:
             prs.proposal_pol = None
 
     def init_proposal_block_parts(self, header) -> None:
-        """Sender-side init for catchup gossip (reference
-        `InitProposalBlockParts` — the committed block at the peer's
-        height is unique, so assume its header)."""
+        """Sender-side (re)init for catchup gossip (reference
+        `gossipDataRoutine` reactor.go:427-464 re-inits whenever the
+        tracked header differs from the stored block's header).
+
+        The RESET case matters: a peer that proposed its OWN block for a
+        later round advertises that proposal, so our model's bitmap
+        refers to the peer's round-R partset — using it as the bitmap
+        for the COMMITTED block marks parts delivered that the peer
+        never got, and catchup never re-sends them (the [25,25,0,25]
+        wedge caught by the stress tier's state dump)."""
         with self._lock:
-            if self.prs.proposal_block_parts is None:
+            if (self.prs.proposal_block_parts is None or
+                    self.prs.proposal_block_parts_header != header):
                 self.prs.proposal_block_parts_header = header
                 self.prs.proposal_block_parts = [False] * header.total
 
@@ -417,9 +425,16 @@ class ConsensusReactor(Reactor):
                 ps.set_has_part(msg.height, msg.part.index)
                 rs = self.cs.get_round_state()
                 parts = rs.proposal_block_parts
+                # duplicate only if the part is OF our current partset
+                # (proof roots at its header) AND we already hold that
+                # index — "same index" alone is not identity: a catchup
+                # part for the committed block must not be dropped
+                # because our own later-round proposal happens to fill
+                # the same slot (stress-tier wedge: heights [25,25,0,25])
                 if not (rs.height == msg.height and parts is not None and
                         0 <= msg.part.index < parts.total and
-                        parts.has_part(msg.part.index)):
+                        parts.has_part(msg.part.index) and
+                        msg.part.verify(parts.header)):
                     self.cs.add_proposal_block_part(msg.height, msg.round,
                                                     msg.part, peer.id)
         elif ch_id == VOTE_CHANNEL:
@@ -525,8 +540,10 @@ class ConsensusReactor(Reactor):
                 prs.height <= self.cs.block_store.height:
             meta = self.cs.block_store.load_block_meta(prs.height)
             if meta is not None:
-                if prs.proposal_block_parts is None:
-                    ps.init_proposal_block_parts(meta.block_id.parts)
+                # (re)key the model to the COMMITTED block's header — a
+                # bitmap tracking the peer's own later-round proposal
+                # must not stand in for it (see init_proposal_block_parts)
+                ps.init_proposal_block_parts(meta.block_id.parts)
                 ours = [True] * meta.block_id.parts.total
                 idx = ps.pick_missing(ours, prs.proposal_block_parts)
                 if idx is not None:

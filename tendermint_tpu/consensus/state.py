@@ -947,6 +947,15 @@ class ConsensusState:
             try:
                 self.proposal_block = Block.decode_bytes(data)
             except ValueError:
+                # proof-valid parts that assemble to an undecodable block
+                # mean the PRODUCER built garbage (Byzantine) — loud, not
+                # silent: a complete-but-undecodable partset is otherwise
+                # an invisible wedge (complete => catchup gossip and the
+                # commit-step belt both stop re-sending)
+                log.error("complete proposal parts failed to decode",
+                          height=height,
+                          parts_hash=self.proposal_block_parts
+                          .header.hash.hex()[:12])
                 self.proposal_block = None
                 return
             self.evsw.fire(ev.COMPLETE_PROPOSAL, self._round_step_event())

@@ -538,6 +538,23 @@ class TpuBackend:
             jnp.asarray(tmpl_idx), jnp.asarray(templates), self._base_tbl))
         return out[:n]
 
+    def precompile_for_validators(self, vals) -> None:
+        """Warm the full crypto plane for a ValidatorSet: THE shared
+        derivation of which (lanes, templates) shapes a node produces —
+        node boot (`node/node.py _maybe_precompile`) and `cli init
+        --warm-crypto` must warm the IDENTICAL set or the "warm first
+        boot" guarantee silently regresses when one site changes."""
+        from tendermint_tpu.blockchain.reactor import DEFAULT_BATCH
+        from tendermint_tpu.types import canonical
+        v = max(vals.size(), 1)
+        # a single gossiped vote, one commit (V lanes / 1 template), and
+        # a full fast-sync verify window (DEFAULT_BATCH blocks x V
+        # lanes, ~one template per block when commits are unanimous)
+        shapes = sorted({(MIN_BUCKET, 1), (_bucket(v), 1),
+                         (_bucket(DEFAULT_BATCH * v), DEFAULT_BATCH)})
+        self.precompile(vals.set_key(), vals.pubs_matrix(), shapes,
+                        canonical.SIGN_BYTES_LEN)
+
     def precompile(self, set_key: bytes, val_pubs: np.ndarray,
                    shapes: list[tuple[int, int]], msg_len: int) -> None:
         """Warm the comb tables for a validator set and the verify

@@ -142,26 +142,15 @@ class Node:
     def _maybe_precompile(self) -> None:
         from tendermint_tpu.crypto import backend as cb
         be = cb.get_backend()
-        if not hasattr(be, "precompile"):
+        if not hasattr(be, "precompile_for_validators"):
             return
-        from tendermint_tpu.blockchain.reactor import DEFAULT_BATCH
-
         vals = self.consensus.state.validators
-        v = max(vals.size(), 1)
-        # the (lanes, templates) shapes this node will actually produce:
-        # a single gossiped vote, one commit (V lanes / 1 template), and
-        # a full fast-sync verify window (DEFAULT_BATCH blocks x V lanes,
-        # ~one template per block when commits are unanimous)
-        shapes = sorted({(cb.MIN_BUCKET, 1), (cb._bucket(v), 1),
-                         (cb._bucket(DEFAULT_BATCH * v), DEFAULT_BATCH)})
 
         def warm():
             try:
-                from tendermint_tpu.types import canonical
                 t0 = time.time()
-                be.precompile(vals.set_key(), vals.pubs_matrix(), shapes,
-                              canonical.SIGN_BYTES_LEN)
-                log.info("crypto precompile done", shapes=shapes,
+                be.precompile_for_validators(vals)
+                log.info("crypto precompile done", validators=vals.size(),
                          seconds=round(time.time() - t0, 1))
             except Exception:
                 log.exception("crypto precompile failed")

@@ -293,3 +293,90 @@ def test_mempool_gossip_height_gates_fast_syncing_peer():
     finally:
         for sw in switches:
             sw.stop()
+
+
+def test_catchup_model_rekeys_on_header_change():
+    """D1 of the [25,25,0,25] stress wedge: the sender's PeerState bitmap
+    tracked the peer's OWN later-round proposal header; catchup gossip
+    then treated it as the committed block's bitmap and never re-sent
+    the parts.  `init_proposal_block_parts` must RESET when the header
+    differs (reference gossipDataRoutine reactor.go:427-464 re-inits on
+    header mismatch)."""
+    from tendermint_tpu.consensus.reactor import PeerState
+    from tendermint_tpu.types.part_set import PartSetHeader
+
+    ps = PeerState(peer=None)
+    h_own = PartSetHeader(1, b"\x11" * 32)     # peer's own r2 proposal
+    h_committed = PartSetHeader(1, b"\x22" * 32)
+    ps.prs.height, ps.prs.round = 1, 2
+    ps.init_proposal_block_parts(h_own)
+    ps.set_has_part(1, 0)                       # model: delivered
+    assert ps.prs.proposal_block_parts == [True]
+    # catchup keys the model to the committed header: must reset
+    ps.init_proposal_block_parts(h_committed)
+    assert ps.prs.proposal_block_parts == [False]
+    assert ps.prs.proposal_block_parts_header == h_committed
+    # re-keying to the SAME header is a no-op (keeps delivered marks)
+    ps.set_has_part(1, 0)
+    ps.init_proposal_block_parts(h_committed)
+    assert ps.prs.proposal_block_parts == [True]
+
+
+def test_part_prefilter_passes_foreign_header_part():
+    """D2 of the [25,25,0,25] stress wedge: the receiver's dedup
+    prefilter dropped a catchup part because its CURRENT partset (its
+    own later-round proposal) already held that index — same index is
+    not identity.  A part whose proof roots at a different header must
+    reach the core."""
+    from tendermint_tpu.consensus.reactor import (ConsensusReactor,
+                                                  DATA_CHANNEL)
+    from tendermint_tpu.consensus.reactor import PeerState
+    from tendermint_tpu.types.part_set import PartSet
+
+    own = PartSet.from_data(b"my own round-2 proposal block bytes")
+    committed = PartSet.from_data(b"the committed round-1 block bytes")
+    assert own.header != committed.header
+
+    class CoreStub:
+        def __init__(self):
+            self.added = []
+            self.block_store = None
+
+        def get_round_state(self):
+            from types import SimpleNamespace
+            return SimpleNamespace(height=1, round=2, step=8,
+                                   proposal=None, votes=None,
+                                   validators=None,
+                                   proposal_block_parts=own,
+                                   commit_round=1, last_commit=None,
+                                   start_time=0)
+
+        def add_proposal_block_part(self, height, round_, part, peer_id):
+            self.added.append((height, part.index))
+
+    class PeerStub:
+        id = "ab" * 10
+
+        def get(self, k):
+            return self._ps
+
+        def set(self, k, v):
+            self._ps = v
+
+    core = CoreStub()
+    r = ConsensusReactor.__new__(ConsensusReactor)   # skip __init__
+    r.cs = core
+    r.fast_sync = False
+    r.switch = None
+    peer = PeerStub()
+    ps = PeerState(peer=peer)
+    ps.prs.height, ps.prs.round = 1, 2
+
+    # a duplicate of OUR OWN partset's part: dropped (true duplicate)
+    r._receive(DATA_CHANNEL, peer, ps,
+               M.BlockPartMessage(1, 2, own.get_part(0)))
+    assert core.added == []
+    # the committed block's part at the same index: must pass through
+    r._receive(DATA_CHANNEL, peer, ps,
+               M.BlockPartMessage(1, 2, committed.get_part(0)))
+    assert core.added == [(1, 0)]
