@@ -159,8 +159,9 @@ class Routes:
             "validators": [
                 {"address": _hexb(v.address),
                  "pub_key": _hexb(v.pub_key.bytes_),
-                 "voting_power": v.voting_power, "accum": v.accum}
-                for v in vs.validators
+                 "voting_power": v.voting_power,
+                 "accum": vs.accum_of(i)}
+                for i, v in enumerate(vs.validators)
             ],
         }
 

@@ -107,8 +107,21 @@ class ValidatorSet:
         self._total = sum(v.voting_power for v in vals)
         self._by_addr = {v.address: i for i, v in enumerate(vals)}
         self._proposer: Validator | None = None
+        # accumulated priorities live in THIS ARRAY, not on the Validator
+        # objects (v.accum is a construction-time input / decode field
+        # only): rotation happens every block and every round, and
+        # array-residency makes increment_accum pure numpy and copy() an
+        # array copy instead of V object allocations — the two were ~18%
+        # of the fast-sync apply stage at V=100
+        self._accums = np.fromiter((v.accum for v in vals), np.int64,
+                                   len(vals))
         if vals:
             self.increment_accum(1)
+
+    def accum_of(self, i: int) -> int:
+        """Accumulated priority of validators[i] (authoritative — the
+        objects' .accum fields are not updated by rotation)."""
+        return int(self._accums[i])
 
     # -- basics ---------------------------------------------------------
     def size(self) -> int:
@@ -128,16 +141,20 @@ class ValidatorSet:
         return address in self._by_addr
 
     def copy(self) -> "ValidatorSet":
+        """O(1)-ish copy: Validator objects are immutable after set
+        construction (rotation state lives in `_accums`; `apply_updates`
+        replaces objects copy-on-write), so copies SHARE them — only the
+        accum array, the list, and the index dict are duplicated."""
         new = ValidatorSet.__new__(ValidatorSet)
-        new.validators = [v.copy() for v in self.validators]
+        new.validators = list(self.validators)
         new._total = self._total
         new._by_addr = dict(self._by_addr)
-        new._proposer = (None if self._proposer is None else
-                         new.validators[self._by_addr[self._proposer.address]])
+        new._proposer = self._proposer
+        new._accums = self._accums.copy()
         # membership-derived caches survive a copy (invalidated only by
         # apply_updates); the hash also survives accum rotation because
         # hash_bytes excludes accum
-        for attr in ("_set_key", "_pubs_mat", "_hash", "_powers"):
+        for attr in ("_set_key", "_pubs_mat", "_hash", "_powers", "_enc"):
             if attr in self.__dict__:
                 new.__dict__[attr] = self.__dict__[attr]
         return new
@@ -163,7 +180,7 @@ class ValidatorSet:
         (equal-power sets at specific heights)."""
         vals = self.validators
         powers = self._powers_arr()
-        accums = np.fromiter((v.accum for v in vals), np.int64, len(vals))
+        accums = self._accums
         for _ in range(times):
             accums += powers
             i = int(np.argmax(accums))
@@ -173,8 +190,6 @@ class ValidatorSet:
                         key=lambda t: vals[t].sort_key)
             accums[i] -= self._total
             self._proposer = vals[i]
-        for v, a in zip(vals, accums.tolist()):
-            v.accum = a
         self.__dict__.pop("_enc", None)    # accum is part of encode()
 
     @property
@@ -236,9 +251,8 @@ class ValidatorSet:
         rows[:, 36:44] = np.asarray(
             [v.voting_power for v in self.validators],
             dtype=">i8").view(np.uint8).reshape(n, 8)
-        rows[:, 44:52] = np.asarray(
-            [v.accum for v in self.validators],
-            dtype=">i8").view(np.uint8).reshape(n, 8)
+        rows[:, 44:52] = self._accums.astype(
+            ">i8").view(np.uint8).reshape(n, 8)
         prop = self.index_of(self._proposer.address) if self._proposer else -1
         e = self.__dict__["_enc"] = u32(n) + rows.tobytes() + i64(prop)
         return e
@@ -253,12 +267,22 @@ class ValidatorSet:
         vs._total = sum(v.voting_power for v in vals)
         vs._by_addr = {v.address: i for i, v in enumerate(vals)}
         vs._proposer = vals[prop] if 0 <= prop < len(vals) else None
+        vs._accums = np.fromiter((v.accum for v in vals), np.int64,
+                                 len(vals))
         return vs
 
     # -- membership updates (ABCI EndBlock diffs) ------------------------
     def apply_updates(self, changes: list[tuple[bytes, int]]) -> None:
         """(pubkey, power) diffs; power 0 removes (reference
-        `state/execution.go:117-156` updateValidators)."""
+        `state/execution.go:117-156` updateValidators).
+
+        COPY-ON-WRITE on the touched validators: objects are shared
+        between set copies (see `copy`), so a power change replaces the
+        object instead of mutating it.  Surviving validators keep their
+        accumulated priority (from this set's array); new entrants start
+        at 0 — the reference's semantics."""
+        accums = {v.address: int(a)
+                  for v, a in zip(self.validators, self._accums)}
         vals = {v.address: v for v in self.validators}
         for pub, power in changes:
             pk = PubKey(pub)
@@ -270,10 +294,14 @@ class ValidatorSet:
                     raise ValueError("removing unknown validator")
                 del vals[addr]
             elif addr in vals:
-                vals[addr].voting_power = power
+                vals[addr] = Validator(pk, power)
             else:
                 vals[addr] = Validator(pk, power)
+                accums[addr] = 0
         self.validators = sorted(vals.values(), key=lambda v: v.address)
+        self._accums = np.fromiter(
+            (accums[v.address] for v in self.validators), np.int64,
+            len(self.validators))
         self._total = sum(v.voting_power for v in self.validators)
         self._by_addr = {v.address: i for i, v in enumerate(self.validators)}
         self._set_key = None     # membership/power changed: invalidate
@@ -284,6 +312,12 @@ class ValidatorSet:
         if (self._proposer is not None and
                 self._proposer.address not in self._by_addr):
             self._proposer = None
+        elif self._proposer is not None:
+            # re-point at the (possibly replaced copy-on-write) object in
+            # self.validators — a re-powered proposer must not linger as
+            # the stale pre-update object
+            self._proposer = self.validators[
+                self._by_addr[self._proposer.address]]
         if self._proposer is None and self.validators:
             self.increment_accum(1)
 

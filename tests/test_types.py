@@ -472,3 +472,74 @@ def test_compact_commit_roundtrip_and_lanes():
     cc2 = CompactCommit.from_commit(commit)
     ls = vs.commit_verify_lanes("cc-chain", bid, 5, cc2)
     assert list(ls[4]) == [i for i in range(8) if i != 3]
+
+
+def test_accum_array_rotation_equivalence():
+    """The array-resident accumulator rotation must match a plain
+    per-object reference implementation over long sequences of
+    increments, copies, and membership updates (accums live on the SET,
+    objects are shared copy-on-write between copies — regression for the
+    replay-hot rewrite)."""
+    import random
+    from tendermint_tpu.types.keys import PrivKey
+    from tendermint_tpu.types.validator import Validator, ValidatorSet
+
+    rng = random.Random(7)
+    privs = [PrivKey.generate() for _ in range(7)]
+    powers = [rng.randint(1, 50) for _ in range(7)]
+
+    # reference model: dict addr -> [power, accum]
+    class Ref:
+        def __init__(self, pairs):
+            self.m = {p.pub_key.address: [pw, 0] for p, pw in pairs}
+
+        def increment(self, times):
+            assert times == 1
+            total = sum(pw for pw, _ in self.m.values())
+            for ent in self.m.values():
+                ent[1] += ent[0]
+            # max accum, ties -> lowest address
+            best = max(self.m.items(),
+                       key=lambda kv: (kv[1][1],
+                                       bytes(255 - b for b in kv[0])))
+            best[1][1] -= total
+            return best[0]
+
+    vs = ValidatorSet([Validator(p.pub_key, pw)
+                       for p, pw in zip(privs[:5], powers[:5])])
+    ref = Ref(list(zip(privs[:5], powers[:5])))
+    ref.increment(1)          # ValidatorSet.__init__ rotates once
+
+    for step in range(60):
+        k = rng.randint(1, 3)
+        snap = vs.copy()      # frozen history (consensus keeps these)
+        snap_accums = [snap.accum_of(i) for i in range(snap.size())]
+        for _ in range(k):
+            want = ref.increment(1)
+        vs.increment_accum(k)
+        assert vs.proposer.address == want, f"step {step}"
+        # the frozen copy must be untouched by the original's rotation
+        assert [snap.accum_of(i) for i in range(snap.size())] == \
+            snap_accums, f"copy leaked at step {step}"
+        if step == 30:
+            # power change + new member: survivors keep accums, the
+            # entrant starts at 0 (reference updateValidators)
+            newp = privs[5]
+            diffs = [(privs[0].pub_key.bytes_, powers[0] + 9),
+                     (newp.pub_key.bytes_, 13)]
+            before = {vs.validators[i].address: vs.accum_of(i)
+                      for i in range(vs.size())}
+            vs.apply_updates(diffs)
+            for i, v in enumerate(vs.validators):
+                if v.address in before:
+                    assert vs.accum_of(i) == before[v.address]
+                else:
+                    assert vs.accum_of(i) == 0
+            ref.m[privs[0].pub_key.address][0] = powers[0] + 9
+            ref.m[newp.pub_key.address] = [13, 0]
+    # encode/decode round-trips the array state
+    from tendermint_tpu.types.codec import Reader
+    vs2 = ValidatorSet.decode(Reader(vs.encode()))
+    assert [vs2.accum_of(i) for i in range(vs2.size())] == \
+        [vs.accum_of(i) for i in range(vs.size())]
+    assert vs2.proposer.address == vs.proposer.address
