@@ -249,6 +249,21 @@ class ConsensusState:
                                                 if pc else None),
                     }
             out["votes"] = votes
+            # commit-progress identity: the fields that diagnose a
+            # commit-step wait (which round is being committed, which
+            # partset the node is filling, whether the block decoded) —
+            # the [25,25,0,25] wedge hunt needed exactly these
+            out["commit_round"] = self.commit_round
+            parts = self.proposal_block_parts
+            out["proposal_block_parts"] = (
+                None if parts is None else {
+                    "header_hash": parts.header.hash.hex()[:16],
+                    "have": parts.count,
+                    "total": parts.total,
+                })
+            out["proposal_block_hash"] = (
+                self.proposal_block.hash().hex()[:16]
+                if self.proposal_block is not None else None)
             prop = self.validators._proposer   # may be None mid-update;
             out["validators"] = {              # a debug dump must not trip
                 "size": self.validators.size(),

@@ -293,11 +293,9 @@ class ValidatorSet:
                 if addr not in vals:
                     raise ValueError("removing unknown validator")
                 del vals[addr]
-            elif addr in vals:
-                vals[addr] = Validator(pk, power)
             else:
                 vals[addr] = Validator(pk, power)
-                accums[addr] = 0
+                accums.setdefault(addr, 0)   # survivors keep theirs
         self.validators = sorted(vals.values(), key=lambda v: v.address)
         self._accums = np.fromiter(
             (accums[v.address] for v in self.validators), np.int64,
