@@ -154,6 +154,13 @@ def test_config() -> Config:
     c.consensus.timeout_precommit_delta = 0.01
     c.consensus.timeout_commit = 0.02
     c.consensus.skip_timeout_commit = True
+    # failed rounds grow exponentially (healthy rounds stay 100ms): at
+    # fixed linear deltas a loaded single-core host can outpace the
+    # timeout growth every round and churn nil rounds for the whole test
+    # budget (the stress tier proved the mode; in-process reactor nets
+    # under full-suite load hit it too, just rarer)
+    c.consensus.timeout_round_growth = 1.5
+    c.consensus.timeout_max = 5.0
     return c
 
 

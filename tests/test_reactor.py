@@ -81,7 +81,11 @@ def _make_net(n, connect=True, cfg_factory=fast_config):
     return nodes, privs
 
 
-def _wait_height(nodes, height, timeout=30.0):
+def _wait_height(nodes, height, timeout=90.0):
+    """Generous default: the property under test is convergence, not
+    bounded latency on a loaded single-core host (a passing net returns
+    in seconds; the budget only matters when scheduler noise stretches
+    early rounds — the stress tier measures that regime separately)."""
     deadline = time.time() + timeout
     while time.time() < deadline:
         if all(nd.block_store.height >= height for nd in nodes):
@@ -129,7 +133,7 @@ def test_late_joiner_catches_up_through_gossip():
         assert late.block_store.height == 0
         for i in range(3):
             connect_switches(nodes[i].switch, late.switch)
-        assert _wait_height([late], 3, timeout=30), \
+        assert _wait_height([late], 3), \
             f"late joiner stuck at {late.block_store.height}"
         for h in range(1, 4):
             assert late.block_store.load_block(h).hash() == \
@@ -165,7 +169,7 @@ def test_sleeper_recovers_through_gossip():
         finally:
             victim.cs._mtx.release()
         target = min(nd.block_store.height for nd in trio)
-        assert _wait_height([victim], target, timeout=30), \
+        assert _wait_height([victim], target), \
             (f"victim stuck at {victim.block_store.height}, "
              f"trio at {[nd.block_store.height for nd in trio]}")
         for h in range(1, target + 1):
