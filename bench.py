@@ -30,6 +30,14 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+# degraded-throughput retry policy (configs 3 and 4): at most 2 retries
+# per config AND a wall-clock budget, then report the best attempt with
+# `degraded: true` — the old open-ended spiral is what timed the whole
+# harness out at rc=124 in BENCH_r05
+MAX_BENCH_ATTEMPTS = 3           # 1 initial + 2 retries
+BENCH_RETRY_BUDGET_S = 600.0
+
+
 # ---------------------------------------------------------------------------
 # fixture construction
 # ---------------------------------------------------------------------------
@@ -721,17 +729,33 @@ def config4_light_multichain(quick: bool) -> dict:
     throughput swings widely run-to-run, so a run below the healthy
     multiple of the in-run scalar anchor retries ONCE on a byte-distinct
     fixture (fresh seeds + header hashes; the transport's result cache
-    cannot flatter the rerun)."""
+    cannot flatter the rerun).  Same cap as config 3: at most
+    MAX_BENCH_ATTEMPTS total tries inside BENCH_RETRY_BUDGET_S, then the
+    best attempt is reported with `degraded: true`."""
+    t_start = time.time()
     attempts = [_config4_attempt(quick, salt=0)]
+    healthy = 0.0
     if not quick:
         scalar = native_scalar_rate(300)
-        if attempts[0]["sigs_per_sec"] < 18 * scalar:
+        healthy = 18 * scalar
+        for salt in (101, 202):
+            if attempts[-1]["sigs_per_sec"] >= healthy:
+                break
+            if len(attempts) >= MAX_BENCH_ATTEMPTS:
+                log("[config4] still degraded after "
+                    f"{len(attempts)} attempts; reporting best as degraded")
+                break
+            if time.time() - t_start > BENCH_RETRY_BUDGET_S:
+                log("[config4] retry budget exhausted; "
+                    "reporting best attempt as degraded")
+                break
             log(f"[config4] degraded run "
-                f"({attempts[0]['sigs_per_sec']:.0f} sigs/s vs anchor "
+                f"({attempts[-1]['sigs_per_sec']:.0f} sigs/s vs anchor "
                 f"{scalar:.0f}); retrying on a fresh fixture")
-            attempts.append(_config4_attempt(quick, salt=101))
+            attempts.append(_config4_attempt(quick, salt=salt))
     out = max(attempts, key=lambda r: r["sigs_per_sec"])
     out["attempts"] = len(attempts)
+    out["degraded"] = bool(not quick and out["sigs_per_sec"] < healthy)
     return out
 
 
@@ -837,26 +861,39 @@ def config3_fastsync(quick: bool) -> dict:
     # template count crossed the 512 bucket would recompile mid-run)
     n_blocks = 326 if quick else 100_000
     anchor = config3_fastsync_cpu_anchor(64 if quick else 128)
+    # the tunneled device's throughput swings widely between runs
+    # (identical 100k replays measured 50s..275s in one session), so a
+    # run below a healthy multiple of the scalar anchor retries on a
+    # byte-distinct fixture (same seeds, salted timestamps -> every hash
+    # differs, so the transport's result cache cannot flatter the
+    # rerun).  HARD CAP at MAX_BENCH_ATTEMPTS: a persistently degraded
+    # device must surface as `degraded: true` in the report, not as the
+    # harness looping until the driver kills it at rc=124 (BENCH_r05).
+    healthy = 15 * anchor["sigs_per_sec"]
+    t_start = time.time()
     attempts = []
-    for salt in (0, 7_777_777):
+    for salt in (0, 7_777_777, 424_242):
         res = _replay_chain(n_vals=100, n_blocks=n_blocks, backend="tpu",
                             target_lanes=65536,
                             window=625 if not quick else None,
                             time_salt=salt)
         attempts.append(res)
-        # the tunneled device's throughput swings widely between runs
-        # (identical 100k replays measured 50s..275s in one session) —
-        # if this attempt cleared a healthy multiple of the scalar
-        # anchor, take it; otherwise retry ONCE on a byte-distinct
-        # fixture (same seeds, salted timestamps -> every hash differs,
-        # so the transport's result cache cannot flatter the rerun)
-        if quick or res["sigs_per_sec"] >= 15 * anchor["sigs_per_sec"]:
+        if quick or res["sigs_per_sec"] >= healthy:
+            break
+        if len(attempts) > MAX_BENCH_ATTEMPTS - 1:
+            log("[config3] still degraded after "
+                f"{len(attempts)} attempts; reporting best as degraded")
+            break
+        if time.time() - t_start > BENCH_RETRY_BUDGET_S:
+            log("[config3] retry budget exhausted; "
+                "reporting best attempt as degraded")
             break
         log("[config3] device throughput looks degraded "
             f"({res['sigs_per_sec']:.0f} sigs/s vs anchor "
             f"{anchor['sigs_per_sec']:.0f}); retrying on a fresh fixture")
     res = max(attempts, key=lambda r: r["sigs_per_sec"])
     res["attempts"] = len(attempts)
+    res["degraded"] = bool(not quick and res["sigs_per_sec"] < healthy)
     res["cpu_pipeline_sigs_per_sec"] = anchor["sigs_per_sec"]
     res["cpu_pipeline_blocks_per_sec"] = anchor["blocks_per_sec"]
     res["config"] = 3

@@ -29,6 +29,7 @@ from tendermint_tpu.types.part_set import from_data_batched
 from tendermint_tpu.types.validator import (CommitPowerError,
                                             CommitSignatureError,
                                             verify_commits_batched)
+from tendermint_tpu.utils.chaos import DeviceFault
 from tendermint_tpu.utils.log import get_logger
 from tendermint_tpu.utils.metrics import REGISTRY
 
@@ -262,6 +263,15 @@ class BlockchainReactor(Reactor):
             try:
                 verify_commits_batched(self.state.validators, chain_id,
                                        items)
+            except DeviceFault as e:
+                # OUR device failed, not the peer: every rung of the
+                # crypto ladder errored out.  Blaming the deliverer here
+                # (redo/evict) would partition us from honest peers for a
+                # local hardware problem — keep the blocks queued and let
+                # the next tick retry once a rung recovers.
+                log.warn("device fault during commit verify; will retry",
+                         height=blocks[0].height, error=str(e)[:200])
+                return False
             except CommitSignatureError as e:
                 # the commit for height h rides in block h+1's LastCommit:
                 # a forged signature implicates the successor's deliverer

@@ -44,6 +44,14 @@ def _load_config(args) -> Config:
         cfg.base.crypto_backend = args.crypto_backend
     if getattr(args, "fast_sync", None) is not None:
         cfg.base.fast_sync = args.fast_sync
+    if getattr(args, "crypto_supervised", None) is not None:
+        cfg.crypto.supervised = args.crypto_supervised
+    if getattr(args, "crypto_breaker_threshold", None):
+        cfg.crypto.breaker_threshold = args.crypto_breaker_threshold
+    if getattr(args, "crypto_call_timeout", None):
+        cfg.crypto.call_timeout_s = args.crypto_call_timeout
+    if getattr(args, "crypto_spot_check", None):
+        cfg.crypto.spot_check_every = args.crypto_spot_check
     return cfg
 
 
@@ -327,6 +335,37 @@ def cmd_replay_console(args) -> int:
     return 0
 
 
+def cmd_wal_fsck(args) -> int:
+    """Check (and optionally repair) the consensus WAL.  Exit 0 when the
+    log is clean, 1 when corruption was found (and left in place), 0
+    after a successful --repair."""
+    from tendermint_tpu.consensus.wal import WAL
+    cfg = _load_config(args)
+    path = args.wal or os.path.join(cfg.base.db_dir(), "cs.wal")
+    if not os.path.exists(path):
+        print(f"no WAL at {path}")
+        return 1
+    report = WAL.fsck(path, repair=args.repair)
+    eh = report["end_heights"]
+    print(f"{path}: {report['records']} records, "
+          f"{len(eh)} committed heights"
+          + (f" (last {eh[-1]})" if eh else ""))
+    for off, skipped in report["bad_regions"]:
+        print(f"  corrupt region at offset {off}: {skipped} bytes skipped")
+    if report["tail_garbage"]:
+        print(f"  torn/corrupt tail: {report['tail_garbage']} bytes")
+    dirty = bool(report["bad_regions"] or report["tail_garbage"])
+    if not dirty:
+        print("clean")
+        return 0
+    if report["repaired"]:
+        print("repaired: rewrote the log with only the valid records")
+        return 0
+    print("corrupt (replay will skip the bad regions; "
+          "run with --repair to rewrite)")
+    return 1
+
+
 def cmd_version(args) -> int:
     print(__version__)
     return 0
@@ -374,6 +413,24 @@ def main(argv=None) -> int:
                     default=None)
     sp.add_argument("--no-fast-sync", dest="fast_sync",
                     action="store_false")
+    sp.add_argument("--crypto-supervised", dest="crypto_supervised",
+                    action="store_true", default=None,
+                    help="wrap the crypto backend in the fault-tolerant "
+                         "ladder (timeouts, retry, circuit breaker; see "
+                         "README 'Failure semantics')")
+    sp.add_argument("--no-crypto-supervised", dest="crypto_supervised",
+                    action="store_false")
+    sp.add_argument("--crypto-breaker-threshold", type=int, default=0,
+                    dest="crypto_breaker_threshold",
+                    help="consecutive device faults before the breaker "
+                         "trips to the next rung")
+    sp.add_argument("--crypto-call-timeout", type=float, default=0.0,
+                    dest="crypto_call_timeout",
+                    help="per-call device timeout in seconds")
+    sp.add_argument("--crypto-spot-check", type=int, default=0,
+                    dest="crypto_spot_check",
+                    help="re-verify one lane of every Nth device batch "
+                         "on the reference backend (0 = off)")
     sp.set_defaults(fn=cmd_node)
 
     sp = sub.add_parser("testnet", help="generate a local testnet")
@@ -399,6 +456,13 @@ def main(argv=None) -> int:
     sp = sub.add_parser("replay", help="replay blocks into the app")
     sp.add_argument("--proxy-app", dest="proxy_app", default="")
     sp.set_defaults(fn=cmd_replay)
+
+    sp = sub.add_parser("wal-fsck", help="check/repair the consensus WAL")
+    sp.add_argument("--wal", default="",
+                    help="explicit WAL path (default: <data dir>/cs.wal)")
+    sp.add_argument("--repair", action="store_true",
+                    help="rewrite the log keeping only valid records")
+    sp.set_defaults(fn=cmd_wal_fsck)
 
     sp = sub.add_parser("version", help="print version")
     sp.set_defaults(fn=cmd_version)

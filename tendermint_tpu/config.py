@@ -124,12 +124,30 @@ class ConsensusConfig:
 
 
 @dataclass
+class CryptoConfig:
+    """Supervised-crypto knobs (crypto/supervised.py).  `supervised`
+    wraps `base.crypto_backend` in the fault-tolerant ladder; the rest
+    tune its breaker/timeout/retry/spot-check behavior.  TM_CRYPTO_*
+    env vars override these when the supervisor is built standalone."""
+    supervised: bool = field(
+        default_factory=lambda: os.environ.get(
+            "TM_CRYPTO_SUPERVISED", "") not in ("", "0", "false"))
+    breaker_threshold: int = 3       # consecutive faults before trip
+    breaker_cooldown_s: float = 30.0  # OPEN -> HALF-OPEN delay
+    call_timeout_s: float = 60.0     # per device call; 0 disables
+    retries: int = 1                 # same-rung retries before fallback
+    spot_check_every: int = 0        # 0 = off; N = re-check 1 lane of
+    #                                  every Nth device verify on the ref
+
+
+@dataclass
 class Config:
     base: BaseConfig = field(default_factory=BaseConfig)
     rpc: RPCConfig = field(default_factory=RPCConfig)
     p2p: P2PConfig = field(default_factory=P2PConfig)
     mempool: MempoolConfig = field(default_factory=MempoolConfig)
     consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
+    crypto: CryptoConfig = field(default_factory=CryptoConfig)
 
 
 def default_config() -> Config:
@@ -166,7 +184,7 @@ def test_config() -> Config:
 
 # --- config file (TOML; reference config/toml.go + viper binding) ---------
 
-_SECTIONS = ("base", "rpc", "p2p", "mempool", "consensus")
+_SECTIONS = ("base", "rpc", "p2p", "mempool", "consensus", "crypto")
 
 
 def config_file(root: str) -> str:
@@ -201,7 +219,10 @@ def save_config_file(cfg: Config, path: str) -> None:
 def load_config_file(path: str, cfg: Config | None = None) -> Config:
     """Overlay a TOML config file onto defaults.  Unknown keys fail loudly
     (a typo silently reverting to a default is how testnets lose nights)."""
-    import tomllib
+    try:
+        import tomllib               # 3.11+ stdlib
+    except ModuleNotFoundError:      # 3.10: same API under the old name
+        import tomli as tomllib
     cfg = cfg or Config()
     with open(path, "rb") as f:
         data = tomllib.load(f)

@@ -36,6 +36,7 @@ from tendermint_tpu.types import events as ev
 from tendermint_tpu.types.events import EventCache, EventSwitch
 from tendermint_tpu.types.priv_validator import DoubleSignError
 from tendermint_tpu.types.vote import ErrVoteConflict
+from tendermint_tpu.utils.chaos import DeviceFault
 from tendermint_tpu.utils.fail import fail_point
 from tendermint_tpu.utils.log import get_logger
 from tendermint_tpu.utils.metrics import REGISTRY
@@ -445,7 +446,15 @@ class ConsensusState:
                 sel.append(v)
         if len(sel) < self.VOTE_MICROBATCH_MIN:
             return set()
-        ok = batch_verify_vote_sigs(self.state.chain_id, vals, sel)
+        try:
+            ok = batch_verify_vote_sigs(self.state.chain_id, vals, sel)
+        except DeviceFault as e:
+            # ladder exhausted mid-burst: "not batched" is a safe answer
+            # here (the scalar add_vote path re-verifies), "rejected"
+            # would throw away honest votes for a local hardware fault
+            log.warn("device fault in vote pre-verify; going scalar",
+                     error=str(e)[:200])
+            return set()
         REGISTRY.vote_microbatches.inc()
         REGISTRY.vote_microbatch_lanes.inc(len(sel))
         return {id(v) for v, good in zip(sel, ok) if good}

@@ -16,10 +16,18 @@ import os
 import struct
 import zlib
 
+from tendermint_tpu.utils.log import get_logger
+
+log = get_logger("wal")
+
 # record kinds
 REC_ENDHEIGHT = 0x01
 REC_MESSAGE = 0x02       # payload: consensus message (msgs.encode_msg)
 REC_TIMEOUT = 0x03       # payload: TimeoutInfo
+
+# resync bound: a frame claiming more than this is treated as garbage,
+# not as a real record we should wait 64MB of scanning to disprove
+MAX_RECORD_BYTES = 64 << 20
 
 
 class WAL:
@@ -57,8 +65,25 @@ class WAL:
 
     # -- reading ---------------------------------------------------------
     @staticmethod
+    def _frame_at(data: bytes, pos: int) -> tuple[int, bytes] | None:
+        """Decode one valid `len||crc||body` frame at `pos`, else None."""
+        if pos + 8 > len(data):
+            return None
+        ln, crc = struct.unpack_from(">II", data, pos)
+        if ln < 1 or ln > MAX_RECORD_BYTES or pos + 8 + ln > len(data):
+            return None
+        body = data[pos + 8:pos + 8 + ln]
+        if (zlib.crc32(body) & 0xFFFFFFFF) != crc:
+            return None
+        return ln, body
+
+    @staticmethod
     def read_all(path: str) -> list[tuple[int, bytes]]:
-        """All (kind, payload) records; stops cleanly at a torn tail."""
+        """All (kind, payload) records.  A corrupt mid-file frame (bit
+        rot, partial overwrite) is skipped by scanning forward for the
+        next offset that decodes as a valid frame — one bad record must
+        not discard every good record written after it.  A torn tail
+        (no further valid frame) still truncates cleanly."""
         out = []
         if not os.path.exists(path):
             return out
@@ -66,15 +91,73 @@ class WAL:
             data = f.read()
         pos = 0
         while pos + 8 <= len(data):
-            ln, crc = struct.unpack_from(">II", data, pos)
-            if pos + 8 + ln > len(data):
-                break  # torn tail
-            body = data[pos + 8:pos + 8 + ln]
-            if (zlib.crc32(body) & 0xFFFFFFFF) != crc:
-                break  # corrupt tail
+            frame = WAL._frame_at(data, pos)
+            if frame is None:
+                resync = WAL._scan_forward(data, pos + 1)
+                if resync is None:
+                    break            # torn/corrupt tail: nothing left
+                log.warn("wal: skipped corrupt region; resynced",
+                         path=path, offset=pos, skipped=resync - pos)
+                pos = resync
+                continue
+            ln, body = frame
             out.append((body[0], body[1:]))
             pos += 8 + ln
         return out
+
+    @staticmethod
+    def _scan_forward(data: bytes, start: int) -> int | None:
+        """First offset >= start where a valid frame decodes, else None.
+        A stray 9-byte match is a ~1-in-4-billion CRC coincidence —
+        acceptable odds for salvaging a crashed validator's log."""
+        for pos in range(start, len(data) - 8):
+            if WAL._frame_at(data, pos) is not None:
+                return pos
+        return None
+
+    @staticmethod
+    def fsck(path: str, repair: bool = False) -> dict:
+        """Report (and optionally repair) WAL corruption.  Returns
+        {records, end_heights, bad_regions: [(offset, skipped)],
+        tail_garbage, repaired}.  Repair rewrites the file atomically
+        with only the valid records, preserving their order."""
+        report = {"records": 0, "end_heights": [], "bad_regions": [],
+                  "tail_garbage": 0, "repaired": False}
+        if not os.path.exists(path):
+            return report
+        with open(path, "rb") as f:
+            data = f.read()
+        good: list[bytes] = []
+        pos = 0
+        while pos + 8 <= len(data):
+            frame = WAL._frame_at(data, pos)
+            if frame is None:
+                resync = WAL._scan_forward(data, pos + 1)
+                if resync is None:
+                    report["tail_garbage"] = len(data) - pos
+                    pos = len(data)
+                    break
+                report["bad_regions"].append((pos, resync - pos))
+                pos = resync
+                continue
+            ln, body = frame
+            good.append(data[pos:pos + 8 + ln])
+            report["records"] += 1
+            if body[0] == REC_ENDHEIGHT and ln == 9:
+                report["end_heights"].append(
+                    struct.unpack(">Q", body[1:])[0])
+            pos += 8 + ln
+        if pos < len(data):
+            report["tail_garbage"] = len(data) - pos
+        if repair and (report["bad_regions"] or report["tail_garbage"]):
+            tmp = path + ".fsck"
+            with open(tmp, "wb") as f:
+                f.write(b"".join(good))
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+            report["repaired"] = True
+        return report
 
     @staticmethod
     def records_since_height(path: str, height: int) -> list | None:

@@ -677,10 +677,17 @@ def _native_backend():
     return NativeBackend()
 
 
+def _supervised_backend():
+    from tendermint_tpu.crypto.supervised import SupervisedBackend
+    return SupervisedBackend.build(
+        os.environ.get("TM_CRYPTO_PRIMARY", "tpu"))
+
+
 _BACKENDS = {
     "python": PythonBackend,
     "tpu": TpuBackend,
     "native": _native_backend,
+    "supervised": _supervised_backend,
 }
 
 _lock = threading.Lock()
@@ -700,6 +707,18 @@ def set_backend(name: str) -> Backend:
                          f"known: {sorted(_BACKENDS)}")
     with _lock:
         _current = _BACKENDS[name]()
+    return _current
+
+
+def set_backend_supervised(primary: str = "tpu", **knobs) -> Backend:
+    """Install a SupervisedBackend laddered from `primary` down to the
+    python floor (see crypto/supervised.py).  `knobs` override the
+    breaker/timeout/retry/spot-check defaults; node boot passes the
+    `[crypto]` config section through here."""
+    global _current
+    from tendermint_tpu.crypto.supervised import SupervisedBackend
+    with _lock:
+        _current = SupervisedBackend.build(primary, **knobs)
     return _current
 
 

@@ -28,6 +28,7 @@ from tendermint_tpu.types.block import BlockID, Commit, Header
 from tendermint_tpu.types.validator import (CommitPowerError,
                                             CommitSignatureError,
                                             ValidatorSet)
+from tendermint_tpu.utils.chaos import DeviceFault
 from tendermint_tpu.utils.log import get_logger
 
 log = get_logger("light")
@@ -141,12 +142,25 @@ class LightClient:
         if block_id.hash != h.hash():
             raise ValueError("commit is not for this header")
         trusted_set = self.trusted.validators
-        if trusted_set.hash() == validators.hash():
-            validators.verify_commit(self.chain_id, block_id, h.height,
-                                     sh.commit)
-        else:
-            verify_commit_any(trusted_set, validators, self.chain_id,
-                              block_id, h.height, sh.commit)
+        for attempt in (0, 1):
+            try:
+                if trusted_set.hash() == validators.hash():
+                    validators.verify_commit(self.chain_id, block_id,
+                                             h.height, sh.commit)
+                else:
+                    verify_commit_any(trusted_set, validators,
+                                      self.chain_id, block_id, h.height,
+                                      sh.commit)
+                break
+            except DeviceFault as e:
+                # our crypto ladder failed, not the header: one bounded
+                # retry (the supervisor may have fallen to a healthy
+                # rung), then propagate as the retryable infra error it
+                # is — the trusted state is untouched either way
+                if attempt:
+                    raise
+                log.warn("device fault verifying header; retrying once",
+                         height=h.height, error=str(e)[:200])
         self.trusted = TrustedState(h.height, h.hash(), validators)
         return self.trusted
 

@@ -43,7 +43,17 @@ class Node:
         self.config = config
         base = config.base
         log_mod.set_level_spec(base.log_level)
-        crypto_backend.set_backend(base.crypto_backend)
+        cr = config.crypto
+        if cr.supervised:
+            crypto_backend.set_backend_supervised(
+                base.crypto_backend,
+                breaker_threshold=cr.breaker_threshold,
+                breaker_cooldown_s=cr.breaker_cooldown_s,
+                call_timeout_s=cr.call_timeout_s,
+                retries=cr.retries,
+                spot_check_every=cr.spot_check_every)
+        else:
+            crypto_backend.set_backend(base.crypto_backend)
 
         # --- storage (reference :70-77) ---
         if base.db_backend == "memdb":
@@ -230,4 +240,9 @@ class Node:
             "validator_count": self.state.validators.size(),
             "consensus": self.consensus.get_round_state_summary(),
             "metrics": metrics.snapshot(),
-        }
+        } | self._crypto_status()
+
+    def _crypto_status(self) -> dict:
+        be = crypto_backend.get_backend()
+        fn = getattr(be, "supervisor_status", None)
+        return {"crypto_supervisor": fn()} if fn is not None else {}

@@ -17,6 +17,7 @@ import numpy as np
 from tendermint_tpu.types import canonical
 from tendermint_tpu.types.codec import Reader, lp_bytes, u32, u64, u8
 from tendermint_tpu.types.part_set import PartSetHeader
+from tendermint_tpu.utils.chaos import DeviceFault
 
 # re-exported vote types
 TYPE_PREVOTE = canonical.TYPE_PREVOTE
@@ -234,8 +235,18 @@ class VoteSet:
                 checkable.append(i)
         ok = np.zeros(len(votes), dtype=bool)
         if checkable:
-            ok[np.array(checkable)] = batch_verify_vote_sigs(
-                self.chain_id, self.val_set, sel)
+            try:
+                ok[np.array(checkable)] = batch_verify_vote_sigs(
+                    self.chain_id, self.val_set, sel)
+            except DeviceFault:
+                # our crypto ladder is down, not the votes: falling
+                # through would label every vote "invalid signature" and
+                # punish honest peers for a local fault.  The scalar
+                # bigint path cannot device-fault.
+                for i, v in zip(checkable, sel):
+                    ok[i] = self.val_set.validators[
+                        v.validator_index].pub_key.verify(
+                            v.sign_bytes(self.chain_id), v.signature)
         out: list[bool | Exception] = []
         for i, v in enumerate(votes):
             if not ok[i]:

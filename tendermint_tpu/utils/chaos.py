@@ -1,0 +1,121 @@
+"""Deterministic device-fault injection for the crypto hot path.
+
+Extends the `utils/fail.py` env-var pattern (crash points selected by
+TM_FAIL_INDEX / TM_FAIL_POINT) to RUNTIME device faults: TM_CHAOS_CRYPTO
+selects a failure mode the supervised crypto backend injects into its
+device rung, so fallback/breaker behavior is testable without real
+hardware failures.
+
+Spec grammar (one mode, comma-separated k=v params):
+
+    TM_CHAOS_CRYPTO=raise:every=N        raise a DeviceFault on every Nth
+                                         device call
+    TM_CHAOS_CRYPTO=latency:ms=X,every=N sleep X ms before every Nth call
+                                         (exercises the per-call timeout)
+    TM_CHAOS_CRYPTO=wrong:lanes=K,every=N  flip the first K result lanes
+                                         of every Nth call (exercises the
+                                         spot-check re-verification)
+
+`every` defaults to 1 (every call).  The schedule is a pure function of
+the call counter, so a given spec produces the identical fault sequence
+on every run — lossy-device regressions replay exactly, the same promise
+`FuzzedConnection(seed=...)` makes for lossy networks.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+
+class DeviceFault(RuntimeError):
+    """An infrastructure failure in a crypto backend: XLA/runtime error,
+    OOM, timeout, hang, or a wrong-answer spot-check mismatch.  NEVER a
+    statement about signature validity — callers must retry/fall back,
+    not report "bad signature" or punish peers."""
+
+
+class CryptoChaos:
+    """One parsed TM_CHAOS_CRYPTO policy with a deterministic call
+    counter.  `before_call` runs the raise/latency modes; `corrupt`
+    applies the wrong-answer mode to a bool result array."""
+
+    MODES = ("raise", "latency", "wrong")
+
+    def __init__(self, mode: str, every: int = 1, ms: float = 0.0,
+                 lanes: int = 1):
+        if mode not in self.MODES:
+            raise ValueError(f"unknown chaos mode {mode!r}; "
+                             f"known: {self.MODES}")
+        if every < 1:
+            raise ValueError("chaos every= must be >= 1")
+        self.mode = mode
+        self.every = every
+        self.ms = ms
+        self.lanes = lanes
+        self.active = True          # tests flip this to "clear" injection
+        self._count = 0
+        self._lock = threading.Lock()
+
+    @classmethod
+    def parse(cls, spec: str) -> "CryptoChaos":
+        """Parse ``mode:key=val,key=val``.  Raises ValueError on junk —
+        a typo'd chaos spec silently injecting nothing would make a
+        passing chaos test meaningless."""
+        mode, _, rest = spec.partition(":")
+        kw: dict = {}
+        for part in filter(None, (p.strip() for p in rest.split(","))):
+            k, eq, v = part.partition("=")
+            if not eq:
+                raise ValueError(f"chaos param {part!r} is not k=v")
+            if k == "every":
+                kw["every"] = int(v)
+            elif k == "ms":
+                kw["ms"] = float(v)
+            elif k == "lanes":
+                kw["lanes"] = int(v)
+            else:
+                raise ValueError(f"unknown chaos param {k!r} in {spec!r}")
+        return cls(mode.strip(), **kw)
+
+    @classmethod
+    def from_env(cls) -> "CryptoChaos | None":
+        spec = os.environ.get("TM_CHAOS_CRYPTO", "")
+        return cls.parse(spec) if spec else None
+
+    def _fire(self) -> bool:
+        """Advance the counter; True when this call is selected."""
+        if not self.active:
+            return False
+        with self._lock:
+            self._count += 1
+            return self._count % self.every == 0
+
+    @property
+    def calls(self) -> int:
+        return self._count
+
+    def before_call(self) -> None:
+        """Raise/latency injection, run where a real device error would
+        surface (inside the supervised device-rung invocation)."""
+        if self.mode == "wrong":
+            return                   # handled after the call, in corrupt()
+        if not self._fire():
+            return
+        if self.mode == "raise":
+            raise DeviceFault(
+                f"chaos: injected device fault (call {self._count})")
+        time.sleep(self.ms / 1000.0)
+
+    def corrupt(self, out):
+        """Wrong-answer mode: flip the first `lanes` lanes of a bool
+        result — the failure shape of a silently corrupting device, which
+        only a reference spot check can catch."""
+        if self.mode != "wrong" or not self._fire():
+            return out
+        import numpy as np
+        out = np.array(out, dtype=bool, copy=True)
+        k = min(self.lanes, len(out))
+        out[:k] = ~out[:k]
+        return out

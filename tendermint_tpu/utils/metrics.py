@@ -106,6 +106,13 @@ class Registry:
         self.device_dispatch_seconds = Summary()  # dispatch->result wall
         #   (includes overlapped host work in pipelined callers)
         self.table_build_seconds = Summary()  # comb-table builds (per set)
+        # supervised-crypto plane (crypto/supervised.py)
+        self.crypto_device_faults = Counter()   # faults seen on any rung
+        self.crypto_fallback_calls = Counter()  # calls served below rung 0
+        self.crypto_breaker_trips = Counter()   # CLOSED/HALF-OPEN -> OPEN
+        self.crypto_breaker_recoveries = Counter()  # HALF-OPEN -> CLOSED
+        self.crypto_spot_checks = Counter()
+        self.crypto_spot_check_mismatches = Counter()
         # live-vote micro-batching (receive-loop burst ingestion)
         self.vote_microbatches = Counter()
         self.vote_microbatch_lanes = Counter()
@@ -133,6 +140,14 @@ class Registry:
                 round(self.device_step_seconds.mean, 6),
             "device_dispatch_seconds_mean":
                 round(self.device_dispatch_seconds.mean, 6),
+            "crypto_device_faults": self.crypto_device_faults.value,
+            "crypto_fallback_calls": self.crypto_fallback_calls.value,
+            "crypto_breaker_trips": self.crypto_breaker_trips.value,
+            "crypto_breaker_recoveries":
+                self.crypto_breaker_recoveries.value,
+            "crypto_spot_checks": self.crypto_spot_checks.value,
+            "crypto_spot_check_mismatches":
+                self.crypto_spot_check_mismatches.value,
             "vote_microbatches": self.vote_microbatches.value,
             "vote_microbatch_lanes": self.vote_microbatch_lanes.value,
             "blocks_synced": self.blocks_synced.value,

@@ -1,0 +1,110 @@
+"""Chaos smoke: fast-sync a >=100-block chain while TM_CHAOS_CRYPTO
+injects device faults into the supervised crypto ladder.
+
+The acceptance shape of the supervised backend (ISSUE 1): with
+`raise:every=50` injected into the device rung, the sync must complete
+with the correct app hash (fallback re-verification), the breaker must
+trip at least once and recover via a half-open probe once injection
+clears, and NO peer may be evicted or banned — the faults are ours, not
+theirs.
+"""
+
+import time
+
+import pytest
+
+from tendermint_tpu.crypto import backend as cb
+from tendermint_tpu.crypto import native
+from tendermint_tpu.crypto.backend import PythonBackend
+from tendermint_tpu.crypto.supervised import CLOSED, SupervisedBackend
+from tendermint_tpu.p2p import connect_switches
+from tendermint_tpu.utils.chaos import CryptoChaos
+from tendermint_tpu.utils.metrics import REGISTRY
+
+from chainutil import build_chain, kvstore_app_hashes, make_genesis, \
+    make_validators
+from test_fastsync import CHAIN, _source_node, _sync_node
+
+pytestmark = pytest.mark.faults
+
+N_BLOCKS = 120
+
+
+def _device_rung():
+    """The most realistic always-available device stand-in: the OpenSSL
+    native backend when its wheel is importable, else a second python
+    instance (the chaos layer is what injects the faults either way)."""
+    if native.AVAILABLE:
+        return "native", native.NativeBackend()
+    return "python-dev", PythonBackend()
+
+
+def test_chaos_fast_sync_completes_without_blaming_peers():
+    privs, vs = make_validators(4)
+    gen = make_genesis(CHAIN, privs)
+    hashes = kvstore_app_hashes(N_BLOCKS)
+    chain = build_chain(privs, vs, CHAIN, N_BLOCKS, app_hashes=hashes)
+    src_sw, _, src_store = _source_node(chain, gen)
+    # small windows => many supervised verify calls, so every=50 fires
+    # several times across the sync
+    sync_sw, bc, cons, sync_store = _sync_node(gen, batch_size=2)
+
+    sup = SupervisedBackend(
+        [_device_rung(), ("python", PythonBackend())],
+        breaker_threshold=1,          # every injected fault trips
+        breaker_cooldown_s=0.2,       # recovers within the same sync
+        retries=0, call_timeout_s=30.0,
+        chaos=CryptoChaos.parse("raise:every=50"))
+    evicted = []
+    orig_evict = bc.pool.on_evict
+    bc.pool.on_evict = lambda p, r: (evicted.append((p, r)),
+                                     orig_evict and orig_evict(p, r))
+
+    faults0 = REGISTRY.crypto_device_faults.value
+    trips0 = REGISTRY.crypto_breaker_trips.value
+    recov0 = REGISTRY.crypto_breaker_recoveries.value
+
+    old = cb._current
+    cb._current = sup
+    src_sw.start(); sync_sw.start()
+    try:
+        connect_switches(sync_sw, src_sw)
+        deadline = time.time() + 90
+        while sync_store.height < N_BLOCKS - 1 and time.time() < deadline:
+            if (REGISTRY.crypto_breaker_trips.value > trips0
+                    and sup.chaos.active):
+                # injection "clears" after the first trip: from here the
+                # half-open probe must restore the device rung for real
+                sup.chaos.active = False
+            time.sleep(0.02)
+        assert sync_store.height >= N_BLOCKS - 1, \
+            f"synced only to {sync_store.height}: {bc.pool.status()}"
+        # correct state despite injected faults: every byte verified
+        for h in range(1, N_BLOCKS - 1, 7):
+            assert sync_store.load_block(h).hash() == \
+                src_store.load_block(h).hash()
+        assert bc.state.app_hash == hashes[N_BLOCKS - 1]
+        # the machinery actually exercised: fault seen, breaker tripped,
+        # half-open probe recovered once injection cleared
+        assert REGISTRY.crypto_device_faults.value > faults0
+        assert REGISTRY.crypto_breaker_trips.value > trips0
+        deadline = time.time() + 10
+        while (REGISTRY.crypto_breaker_recoveries.value == recov0
+               and time.time() < deadline):
+            # drive a probe if the sync finished while the breaker was
+            # still cooling down
+            import numpy as np
+            from tendermint_tpu.crypto import pure_ed25519 as ref
+            seed = bytes(32)
+            pub = np.frombuffer(ref.pubkey_from_seed(seed), np.uint8)
+            msg = np.zeros(32, np.uint8)
+            sig = np.frombuffer(ref.sign(seed, msg.tobytes()), np.uint8)
+            sup.verify_batch(pub[None, :], msg[None, :], sig[None, :])
+            time.sleep(0.05)
+        assert REGISTRY.crypto_breaker_recoveries.value > recov0
+        assert sup._rungs[0].state == CLOSED
+        # and nobody was blamed for our own hardware's sins
+        assert not evicted, f"peer evicted for an injected fault: {evicted}"
+    finally:
+        src_sw.stop(); sync_sw.stop()
+        cb._current = old

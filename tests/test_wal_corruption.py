@@ -1,0 +1,195 @@
+"""WAL mid-file corruption: resync, fsck, and node restart.
+
+The seed's `read_all` treated ANY bad CRC as a torn tail and discarded
+every record after it — one flipped bit near the head of the log erased
+the whole recovery history.  These tests pin the repaired behavior: skip
+the corrupt frame, resync on the next valid frame, keep reading; and a
+node restarted on a corrupted log keeps committing.
+"""
+
+import os
+import struct
+import time
+import zlib
+
+import pytest
+
+from tendermint_tpu.consensus.wal import (REC_ENDHEIGHT, REC_MESSAGE,
+                                          REC_TIMEOUT, WAL)
+
+pytestmark = pytest.mark.faults
+
+
+def _write_wal(path, heights=3, msgs_per_height=4):
+    w = WAL(path)
+    expect = []
+    for h in range(1, heights + 1):
+        for i in range(msgs_per_height):
+            payload = bytes([h, i]) * (10 + i)
+            w.save_message(payload)
+            expect.append((REC_MESSAGE, payload))
+        w.write_end_height(h)
+        expect.append((REC_ENDHEIGHT, struct.pack(">Q", h)))
+    w.close()
+    return expect
+
+
+def _record_bounds(path):
+    data = open(path, "rb").read()
+    bounds, pos = [], 0
+    while pos + 8 <= len(data):
+        ln = struct.unpack_from(">II", data, pos)[0]
+        if pos + 8 + ln > len(data):
+            break
+        bounds.append(pos)
+        pos += 8 + ln
+    return bounds
+
+
+def _flip_byte(path, offset):
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        b = f.read(1)
+        f.seek(offset)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+
+def test_read_all_resyncs_past_interior_corruption(tmp_path):
+    """Flip one byte in an interior record's body: that record is lost,
+    EVERY record after it is recovered."""
+    path = str(tmp_path / "cs.wal")
+    expect = _write_wal(path)
+    bounds = _record_bounds(path)
+    victim = len(bounds) // 2
+    _flip_byte(path, bounds[victim] + 10)     # inside the body
+    got = WAL.read_all(path)
+    assert got == expect[:victim] + expect[victim + 1:]
+
+
+def test_read_all_resyncs_past_corrupt_length_field(tmp_path):
+    """Corruption in the FRAME HEADER (length bytes) desynchronizes the
+    walk itself; the scanner must still find the next real record."""
+    path = str(tmp_path / "cs.wal")
+    expect = _write_wal(path)
+    bounds = _record_bounds(path)
+    victim = 2
+    _flip_byte(path, bounds[victim] + 1)      # u32 len, big byte
+    got = WAL.read_all(path)
+    assert got == expect[:victim] + expect[victim + 1:]
+
+
+def test_read_all_still_truncates_torn_tail(tmp_path):
+    """A torn TAIL (crash mid-write) is not 'corruption to skip': the
+    partial record is dropped and reading ends cleanly."""
+    path = str(tmp_path / "cs.wal")
+    expect = _write_wal(path)
+    bounds = _record_bounds(path)
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(bounds[-1] + 5)            # mid-frame cut
+    assert WAL.read_all(path) == expect[:-1]
+    assert size > bounds[-1] + 5
+
+
+def test_records_since_height_survives_early_corruption(tmp_path):
+    """Replay catchup: corruption BEFORE the last ENDHEIGHT marker must
+    not affect the records handed to recovery."""
+    path = str(tmp_path / "cs.wal")
+    _write_wal(path, heights=3)
+    w = WAL(path)                             # in-progress height 4
+    for i in range(3):
+        w.save_message(bytes([4, i]) * 8)
+    w.close()
+    bounds = _record_bounds(path)
+    _flip_byte(path, bounds[1] + 10)          # height-1 region
+    recs = WAL.records_since_height(path, 4)
+    # exactly the three height-4 messages, unaffected by the corruption
+    assert recs is not None and len(recs) == 3
+    assert all(k == REC_MESSAGE for k, _ in recs)
+
+
+def test_fsck_reports_and_repairs(tmp_path):
+    path = str(tmp_path / "cs.wal")
+    expect = _write_wal(path)
+    bounds = _record_bounds(path)
+    _flip_byte(path, bounds[3] + 10)
+    report = WAL.fsck(path)
+    assert report["records"] == len(expect) - 1
+    assert len(report["bad_regions"]) == 1
+    assert report["bad_regions"][0][0] == bounds[3]
+    assert report["end_heights"] == [1, 2, 3]
+    assert not report["repaired"]
+    # repair rewrites with only the valid frames; a second pass is clean
+    report = WAL.fsck(path, repair=True)
+    assert report["repaired"]
+    clean = WAL.fsck(path)
+    assert not clean["bad_regions"] and not clean["tail_garbage"]
+    assert WAL.read_all(path) == expect[:3] + expect[4:]
+
+
+def test_wal_fsck_cli(tmp_path, capsys):
+    from tendermint_tpu.cli import main
+    path = str(tmp_path / "cs.wal")
+    _write_wal(path)
+    assert main(["wal-fsck", "--wal", path]) == 0
+    assert "clean" in capsys.readouterr().out
+    bounds = _record_bounds(path)
+    _flip_byte(path, bounds[2] + 10)
+    assert main(["wal-fsck", "--wal", path]) == 1
+    out = capsys.readouterr().out
+    assert "corrupt region" in out
+    assert main(["wal-fsck", "--wal", path, "--repair"]) == 0
+    assert main(["wal-fsck", "--wal", path]) == 0
+
+
+def test_node_restarts_and_commits_past_corrupt_wal(tmp_path):
+    """The acceptance shape: run a real (in-process, sqlite-backed)
+    validator for a few heights, flip one byte in an interior WAL
+    record, restart — the node must come back up and KEEP COMMITTING."""
+    from tendermint_tpu.config import test_config as fast_config
+    from tendermint_tpu.node.node import Node
+    from tendermint_tpu.types import (GenesisDoc, GenesisValidator, PrivKey,
+                                      PrivValidator)
+
+    home = str(tmp_path / "home")
+    pv_seed = PrivKey(b"\x31" * 32)
+
+    def make_node():
+        cfg = fast_config()
+        cfg.base.home = home
+        cfg.base.db_backend = "sqlite"
+        cfg.rpc.laddr = ""
+        cfg.p2p.laddr = ""
+        pv = PrivValidator(pv_seed)
+        gen = GenesisDoc(chain_id="walchaos-chain",
+                         validators=[GenesisValidator(pv.pub_key.bytes_,
+                                                      10)],
+                         genesis_time_ns=1)
+        return Node(cfg, priv_validator=pv, genesis_doc=gen)
+
+    n1 = make_node()
+    n1.start()
+    deadline = time.time() + 30
+    while n1.block_store.height < 4 and time.time() < deadline:
+        time.sleep(0.02)
+    h1 = n1.block_store.height
+    n1.stop()
+    assert h1 >= 4, f"seed node only reached height {h1}"
+
+    wal_path = os.path.join(home, "data", "cs.wal")
+    bounds = _record_bounds(wal_path)
+    assert len(bounds) >= 6
+    _flip_byte(wal_path, bounds[2] + 10)      # interior, early height
+    skipped = WAL.fsck(wal_path)["bad_regions"]
+    assert skipped, "corruption not where we thought"
+
+    n2 = make_node()
+    n2.start()
+    try:
+        deadline = time.time() + 30
+        while n2.block_store.height < h1 + 2 and time.time() < deadline:
+            time.sleep(0.02)
+        assert n2.block_store.height >= h1 + 2, \
+            f"restarted node stuck at {n2.block_store.height} (was {h1})"
+    finally:
+        n2.stop()
