@@ -22,8 +22,13 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import signal
 import sys
+import threading
 import time
+
+from tendermint_tpu.utils import tracing
 
 
 def log(msg: str) -> None:
@@ -36,6 +41,150 @@ def log(msg: str) -> None:
 # harness out at rc=124 in BENCH_r05
 MAX_BENCH_ATTEMPTS = 3           # 1 initial + 2 retries
 BENCH_RETRY_BUDGET_S = 600.0
+
+
+# ---------------------------------------------------------------------------
+# capture-proofing: partial results, signal flush, wall-clock budget
+# ---------------------------------------------------------------------------
+
+def _headline(results: dict) -> dict:
+    """The single stdout JSON line the driver records, computed from
+    whatever configs have COMPLETED so far — callable from the signal
+    handler as well as the normal exit path, so a killed run still
+    reports its best finished number."""
+    anchor = results.get("native_scalar_sigs_per_sec") or 0.0
+    c3 = results.get("config3", {})
+    c1 = results.get("config1", {})
+    if "sigs_per_sec" in c3:
+        v = c3["sigs_per_sec"]
+        return {"metric": "fastsync_replay_commit_sigs_per_sec",
+                "value": round(v, 1), "unit": "sigs/s",
+                "vs_baseline": round(v / anchor, 2) if anchor else 0}
+    if "sigs_per_sec" in c1:
+        v = c1["sigs_per_sec"]
+        return {"metric": "batch_verify_sigs_per_sec",
+                "value": round(v, 1), "unit": "sigs/s",
+                "vs_baseline": round(v / anchor, 2) if anchor else 0}
+    return {"metric": "bench_failed", "value": 0, "unit": "",
+            "vs_baseline": 0}
+
+
+class BenchCheckpoint:
+    """Atomic partial-results file, written the moment each config
+    completes, plus SIGTERM/SIGALRM handlers that flush the
+    headline-so-far before dying.  A `timeout`-killed bench (BENCH_r05:
+    rc=124, parsed: null) then still leaves (a) a parseable JSON file
+    with every completed config and (b) a final headline line on
+    stdout, instead of losing the whole run."""
+
+    def __init__(self, path: str, trace_path: str | None = None):
+        self.path = path
+        self.trace_path = trace_path
+        self.results: dict = {}
+        self._lock = threading.Lock()
+
+    def record(self, key: str, value) -> None:
+        with self._lock:
+            self.results[key] = value
+        self.flush()
+
+    def flush(self, final: bool = False) -> None:
+        with self._lock:
+            doc = {"partial": not final, "results": dict(self.results),
+                   "headline": _headline(self.results)}
+        d = os.path.dirname(os.path.abspath(self.path))
+        os.makedirs(d, exist_ok=True)
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, default=str)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+
+    def install_signal_handlers(self) -> None:
+        dying = threading.Event()
+
+        def _die(signum, frame):
+            if dying.is_set():      # watcher + deferred handler both fire
+                return
+            dying.set()
+            log(f"[bench] caught signal {signum}; "
+                "flushing partial results and dying")
+            try:
+                self.flush()
+            except Exception:
+                pass
+            if self.trace_path:
+                try:
+                    tracing.RECORDER.dump(self.trace_path)
+                except Exception:
+                    pass
+            try:
+                print(json.dumps(_headline(self.results)), flush=True)
+            except Exception:
+                pass
+            os._exit(124)
+        signal.signal(signal.SIGTERM, _die)
+        signal.signal(signal.SIGALRM, _die)
+        # A Python-level handler only runs between bytecodes: a SIGTERM
+        # landing mid-XLA-compile (a minutes-long C call on this host) is
+        # deferred until the call returns, and `timeout -k` hard-kills the
+        # process long before that.  The wakeup fd is written from the
+        # C-level trampoline regardless, so a watcher thread can flush
+        # even while the main thread is stuck inside the compiler.
+        rfd, wfd = os.pipe()
+        os.set_blocking(wfd, False)
+        signal.set_wakeup_fd(wfd, warn_on_full_buffer=False)
+
+        def _watch():
+            while True:
+                try:
+                    data = os.read(rfd, 16)
+                except OSError:
+                    return
+                if any(b in (signal.SIGTERM, signal.SIGALRM)
+                       for b in data):
+                    _die(data[0], None)
+
+        threading.Thread(target=_watch, daemon=True,
+                         name="bench-signal-watch").start()
+
+
+class BudgetManager:
+    """Deadline-aware wall-clock budget.  `allows(cost_s)` answers "can
+    a step with this span-measured cost still finish before the
+    deadline" — the retry loops consult it with the flight recorder's
+    last `bench.fixture_build` duration, so a retry whose fixture
+    rebuild alone would blow the budget is skipped up front instead of
+    being killed mid-build with nothing to show (the BENCH_r05 failure
+    shape)."""
+
+    def __init__(self, budget_s: float = 0.0):
+        self.deadline = (time.monotonic() + budget_s
+                         if budget_s and budget_s > 0 else None)
+
+    def remaining(self) -> float:
+        if self.deadline is None:
+            return float("inf")
+        return self.deadline - time.monotonic()
+
+    def allows(self, cost_s: float, label: str = "") -> bool:
+        if self.deadline is None:
+            return True
+        rem = self.remaining()
+        if cost_s >= rem:
+            log(f"[budget] skipping {label or 'step'}: needs "
+                f"~{cost_s:.0f}s, {rem:.0f}s of budget left")
+            return False
+        return True
+
+
+BUDGET = BudgetManager(0.0)      # replaced in main() when --budget is set
+
+
+def _last_fixture_cost() -> float:
+    rec = tracing.RECORDER.last("bench.fixture_build")
+    return rec["dur"] if rec else 0.0
 
 
 # ---------------------------------------------------------------------------
@@ -83,17 +232,72 @@ def _build_bench_chain(n_vals: int, n_blocks: int, txs_per_block: int = 1):
     sys.path.insert(0, "tests")
     from chainutil import (build_chain, kvstore_app_hashes, make_genesis,
                            make_validators)
-    privs, vs = make_validators(n_vals)
-    gen = make_genesis("bench-chain", privs)
-    hashes = kvstore_app_hashes(n_blocks, txs_per_block)
-    chain = build_chain(privs, vs, "bench-chain", n_blocks,
-                        txs_per_block=txs_per_block, app_hashes=hashes)
+    with tracing.span("bench.fixture_build", n_vals=n_vals,
+                      n_blocks=n_blocks, builder="host"):
+        privs, vs = make_validators(n_vals)
+        gen = make_genesis("bench-chain", privs)
+        hashes = kvstore_app_hashes(n_blocks, txs_per_block)
+        chain = build_chain(privs, vs, "bench-chain", n_blocks,
+                            txs_per_block=txs_per_block, app_hashes=hashes)
     return privs, vs, gen, chain
+
+
+# -- on-disk fixture cache --------------------------------------------------
+# The expensive, deterministic parts of the two-pass builder (the kvstore
+# app-hash loop and the 10M-lane device signing) are cached keyed on
+# (n_vals, n_blocks, payload, time_salt); pass-1 block assembly always
+# re-runs (the objects are cheap to build, expensive to serialize).  A
+# cached sig matrix is native-spot-checked against freshly rebuilt
+# templates before use — any inconsistency evicts the entry and rebuilds.
+
+def _fixture_cache_file(n_vals: int, n_blocks: int, payload: int,
+                        time_salt: int) -> str:
+    d = os.environ.get("TM_BENCH_CACHE_DIR",
+                       "/tmp/tendermint_tpu_bench_cache")
+    return os.path.join(
+        d, f"chain_v{n_vals}_b{n_blocks}_p{payload}_s{time_salt}.npz")
+
+
+def _fixture_cache_load(path: str):
+    """(app_hashes list, sigs matrix) or None."""
+    import numpy as np
+    if not os.path.exists(path):
+        return None
+    try:
+        with np.load(path, allow_pickle=True) as z:
+            hashes = [bytes(h) for h in z["app_hashes"]]
+            sigs = np.array(z["sigs"])
+        return hashes, sigs
+    except Exception as e:
+        log(f"[fixture] cache load failed ({e}); rebuilding")
+        return None
+
+
+def _fixture_cache_save(path: str, hashes: list, sigs) -> None:
+    import numpy as np
+    cap_mb = float(os.environ.get("TM_BENCH_CACHE_MAX_MB", "2048"))
+    if sigs.nbytes / 1e6 > cap_mb:
+        log(f"[fixture] cache entry {sigs.nbytes / 1e6:.0f}MB exceeds "
+            f"TM_BENCH_CACHE_MAX_MB={cap_mb:.0f}; not caching")
+        return
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, app_hashes=np.array(hashes, dtype=object),
+                     sigs=sigs)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        log(f"[fixture] cached to {path} ({sigs.nbytes / 1e6:.0f}MB)")
+    except OSError as e:
+        log(f"[fixture] cache save failed ({e}); continuing uncached")
 
 
 def _build_bench_chain_fast(n_vals: int, n_blocks: int,
                             payload: int = 12 * 1024,
-                            time_salt: int = 0):
+                            time_salt: int = 0,
+                            _use_cache: bool = True):
     """Two-pass fixture for the NAMED 100k-block scale (BASELINE config 3).
 
     The small builder host-signs every commit sequentially (~6k sigs/s
@@ -129,6 +333,9 @@ def _build_bench_chain_fast(n_vals: int, n_blocks: int,
     from tendermint_tpu.abci.app import create_app
 
     chain_id = "bench-chain"
+    t_build0 = time.perf_counter()
+    cache_file = _fixture_cache_file(n_vals, n_blocks, payload, time_salt)
+    cached = _fixture_cache_load(cache_file) if _use_cache else None
     privs, vs = make_validators(n_vals)
     gen = make_genesis(chain_id, privs)
 
@@ -141,17 +348,22 @@ def _build_bench_chain_fast(n_vals: int, n_blocks: int,
         # state keeps per-block work identical at every height for both
         return [b"p=%d:" % h + b"\xaa" * payload]
 
-    log(f"[fixture] app hashes for {n_blocks} blocks...")
-    t0 = time.perf_counter()
-    app = create_app("kvstore")
-    hashes = []
-    for h in range(1, n_blocks + 1):
-        for tx in txs_for(h):
-            app.deliver_tx(tx)
-        hashes.append(app.commit().data)
-    hashes.insert(0, b"")
-    hashes.pop()
-    log(f"[fixture] app hashes done in {time.perf_counter() - t0:.1f}s")
+    if cached is not None:
+        hashes = cached[0]
+        log(f"[fixture] app hashes loaded from cache ({cache_file})")
+    else:
+        log(f"[fixture] app hashes for {n_blocks} blocks...")
+        t0 = time.perf_counter()
+        app = create_app("kvstore")
+        hashes = []
+        for h in range(1, n_blocks + 1):
+            for tx in txs_for(h):
+                app.deliver_tx(tx)
+            hashes.append(app.commit().data)
+        hashes.insert(0, b"")
+        hashes.pop()
+        log(f"[fixture] app hashes done in "
+            f"{time.perf_counter() - t0:.1f}s")
 
     vals_hash = vs.hash()
     log(f"[fixture] pass 1: building {n_blocks} hash-linked blocks...")
@@ -177,8 +389,6 @@ def _build_bench_chain_fast(n_vals: int, n_blocks: int,
         last_block_id = bid
     log(f"[fixture] pass 1 done in {time.perf_counter() - t0:.1f}s")
 
-    log(f"[fixture] pass 2: device-signing {n_blocks * n_vals} "
-        f"seen-commit lanes...")
     t0 = time.perf_counter()
     bh = np.frombuffer(b"".join(b.hash for b in bids),
                        np.uint8).reshape(n_blocks, 32)
@@ -190,30 +400,69 @@ def _build_bench_chain_fast(n_vals: int, n_blocks: int,
         np.arange(1, n_blocks + 1, dtype=np.int64),
         np.zeros(n_blocks, np.int64), bh, ph, pt)
     seeds = [p.priv_key.seed for p in privs]
-    prev = cb._current
-    be = cb.set_backend("tpu")
-    ch = 655                       # 65,500-lane device chunks
-    val_idx = np.tile(np.arange(n_vals, dtype=np.int32), ch)
-    sigs = np.zeros((n_blocks * n_vals, 64), np.uint8)
-    for off in range(0, n_blocks, ch):
-        hi = min(off + ch, n_blocks)
-        tmpl = templates[off:hi]
-        if hi - off < ch:          # pad template rows: keep ONE jit shape
-            tmpl = np.concatenate(
-                [tmpl, np.zeros((ch - (hi - off), tmpl.shape[1]),
-                                np.uint8)])
-        k = (hi - off) * n_vals
-        sigs[off * n_vals:hi * n_vals] = be.sign_grouped_templated(
-            seeds, val_idx[:k],
-            np.repeat(np.arange(hi - off, dtype=np.int32), n_vals), tmpl)
-    cb._current = prev
-    for i in np.random.default_rng(3).integers(0, len(sigs), 16):
-        v = int(i) % n_vals
-        if not native.verify_one(privs[v].pub_key.bytes_,
-                                 templates[int(i) // n_vals].tobytes(),
-                                 sigs[int(i)].tobytes()):
-            raise RuntimeError(f"device-signed fixture lane {i} invalid")
-    log(f"[fixture] pass 2 done in {time.perf_counter() - t0:.1f}s")
+    from tendermint_tpu.crypto import pure_ed25519 as ref
+    vfy = native.verify_one if native.AVAILABLE else ref.verify
+    sigs = None
+    if cached is not None:
+        sigs = cached[1]
+        ok = sigs.shape == (n_blocks * n_vals, 64)
+        if ok:
+            for i in np.random.default_rng(3).integers(0, len(sigs), 16):
+                v = int(i) % n_vals
+                if not vfy(privs[v].pub_key.bytes_,
+                           templates[int(i) // n_vals].tobytes(),
+                           sigs[int(i)].tobytes()):
+                    ok = False
+                    break
+        if not ok:
+            # cache inconsistent with the rebuilt chain (or corrupt):
+            # evict and rebuild the whole fixture — the app hashes that
+            # fed pass 1 came from the same suspect entry
+            log("[fixture] cache spot-check FAILED; evicting + rebuilding")
+            try:
+                os.remove(cache_file)
+            except OSError:
+                pass
+            gc.enable()
+            del blocks, bids
+            gc.collect()
+            return _build_bench_chain_fast(n_vals, n_blocks,
+                                           payload=payload,
+                                           time_salt=time_salt,
+                                           _use_cache=False)
+        log(f"[fixture] pass 2: {n_blocks * n_vals} sig lanes loaded "
+            "from cache (spot-check ok)")
+    if sigs is None:
+        log(f"[fixture] pass 2: device-signing {n_blocks * n_vals} "
+            f"seen-commit lanes...")
+        prev = cb._current
+        be = cb.set_backend("tpu")
+        ch = 655                       # 65,500-lane device chunks
+        val_idx = np.tile(np.arange(n_vals, dtype=np.int32), ch)
+        sigs = np.zeros((n_blocks * n_vals, 64), np.uint8)
+        for off in range(0, n_blocks, ch):
+            hi = min(off + ch, n_blocks)
+            tmpl = templates[off:hi]
+            if hi - off < ch:      # pad template rows: keep ONE jit shape
+                tmpl = np.concatenate(
+                    [tmpl, np.zeros((ch - (hi - off), tmpl.shape[1]),
+                                    np.uint8)])
+            k = (hi - off) * n_vals
+            sigs[off * n_vals:hi * n_vals] = be.sign_grouped_templated(
+                seeds, val_idx[:k],
+                np.repeat(np.arange(hi - off, dtype=np.int32), n_vals),
+                tmpl)
+        cb._current = prev
+        for i in np.random.default_rng(3).integers(0, len(sigs), 16):
+            v = int(i) % n_vals
+            if not vfy(privs[v].pub_key.bytes_,
+                       templates[int(i) // n_vals].tobytes(),
+                       sigs[int(i)].tobytes()):
+                raise RuntimeError(
+                    f"device-signed fixture lane {i} invalid")
+        log(f"[fixture] pass 2 done in {time.perf_counter() - t0:.1f}s")
+        if _use_cache:
+            _fixture_cache_save(cache_file, hashes, sigs)
 
     t0 = time.perf_counter()
     from tendermint_tpu.types.block import CompactCommit
@@ -239,6 +488,11 @@ def _build_bench_chain_fast(n_vals: int, n_blocks: int,
     gc.freeze()
     gc.enable()
     log(f"[fixture] commit assembly done in {time.perf_counter() - t0:.1f}s")
+    tracing.RECORDER.record(
+        "bench.fixture_build", tracing._EPOCH_T0 + t_build0,
+        time.perf_counter() - t_build0,
+        {"n_vals": n_vals, "n_blocks": n_blocks, "salt": time_salt,
+         "cached": cached is not None})
     return privs, vs, gen, chain
 
 
@@ -574,53 +828,57 @@ def _replay_chain(n_vals: int, n_blocks: int, backend: str,
         per block plus per-lane (sig, validator index, template index) —
         the device assembles messages and gathers pubkeys itself, so the
         host ships 72 B/lane instead of 228 B."""
-        items, lanes = [], []
-        # partial thread-level overlap: the hashlib/merkle C calls inside
-        # make_part_set release the GIL (block encodes are cache-seeded),
-        # measured ~25% off the prep stage; lane assembly (pure Python)
-        # stays serial below
-        parts_list = list(prep_pool.map(
-            lambda b: b[0].make_part_set(), blocks))
-        for (block, _, seen), parts in zip(blocks, parts_list):
-            bid = BlockID(block.hash(), parts.header)
-            items.append((bid, block.height, seen, parts))
-            lanes.append(vals.commit_verify_lanes(chain_id, bid,
-                                                  block.height, seen))
-        templates, tmpl_idx, sigs, idxs = merge_commit_lanes(lanes)
-        prefetch = getattr(cb.get_backend(), "prefetch_grouped_lanes",
-                           None)
-        if prefetch is not None:
-            # start the multi-MB host->device copies from the prep
-            # stage (measured ~0.15s of the 0.46s full-path window cost
-            # rides the tunnel while this thread hashes the next window
-            # instead of stalling the verify thread's dispatch); the
-            # backend owns its bucketing, and real_n keeps telemetry
-            # and result trims keyed to real lanes
-            idxs, tmpl_idx, templates, sigs, n = prefetch(
-                idxs, tmpl_idx, templates, sigs)
-            return items, lanes, templates, tmpl_idx, sigs, idxs, n
-        return items, lanes, templates, tmpl_idx, sigs, idxs, len(idxs)
+        with tracing.span("bench.prep", blocks=len(blocks)):
+            items, lanes = [], []
+            # partial thread-level overlap: the hashlib/merkle C calls
+            # inside make_part_set release the GIL (block encodes are
+            # cache-seeded), measured ~25% off the prep stage; lane
+            # assembly (pure Python) stays serial below
+            parts_list = list(prep_pool.map(
+                lambda b: b[0].make_part_set(), blocks))
+            for (block, _, seen), parts in zip(blocks, parts_list):
+                bid = BlockID(block.hash(), parts.header)
+                items.append((bid, block.height, seen, parts))
+                lanes.append(vals.commit_verify_lanes(chain_id, bid,
+                                                      block.height, seen))
+            templates, tmpl_idx, sigs, idxs = merge_commit_lanes(lanes)
+            prefetch = getattr(cb.get_backend(),
+                               "prefetch_grouped_lanes", None)
+            if prefetch is not None:
+                # start the multi-MB host->device copies from the prep
+                # stage (measured ~0.15s of the 0.46s full-path window
+                # cost rides the tunnel while this thread hashes the
+                # next window instead of stalling the verify thread's
+                # dispatch); the backend owns its bucketing, and real_n
+                # keeps telemetry and result trims keyed to real lanes
+                idxs, tmpl_idx, templates, sigs, n = prefetch(
+                    idxs, tmpl_idx, templates, sigs)
+                return items, lanes, templates, tmpl_idx, sigs, idxs, n
+            return items, lanes, templates, tmpl_idx, sigs, idxs, len(idxs)
 
     def _dispatch(prepped):
         """Stage 2a: upload + queue the grouped device batch (async)."""
         items, lanes, templates, tmpl_idx, sigs, idxs, n = prepped
-        fut = cb.verify_grouped_templated_async(
-            set_key, pubs_mat, idxs, tmpl_idx, templates, sigs, real_n=n)
+        with tracing.span("bench.dispatch", blocks=len(items), lanes=n):
+            fut = cb.verify_grouped_templated_async(
+                set_key, pubs_mat, idxs, tmpl_idx, templates, sigs,
+                real_n=n)
         return items, lanes, fut
 
     def _collect(items, lanes, fut):
         """Stage 2b: block on the device result + per-commit tallies."""
-        ok = fut()
-        off = 0
-        for (bid, h, _, _), a in zip(items, lanes):
-            n = len(a[4])
-            if not ok[off:off + n].all():
-                raise CommitSignatureError(
-                    h, int(np.argmin(ok[off:off + n])))
-            off += n
-            tallied = int(a[3].sum())
-            if not tallied * 3 > total_power * 2:
-                raise CommitPowerError(h, tallied, total_power)
+        with tracing.span("bench.verify", blocks=len(items)):
+            ok = fut()
+            off = 0
+            for (bid, h, _, _), a in zip(items, lanes):
+                n = len(a[4])
+                if not ok[off:off + n].all():
+                    raise CommitSignatureError(
+                        h, int(np.argmin(ok[off:off + n])))
+                off += n
+                tallied = int(a[3].sum())
+                if not tallied * 3 > total_power * 2:
+                    raise CommitPowerError(h, tallied, total_power)
 
     def _verify(*prepped):
         _collect(*_dispatch(prepped))
@@ -691,11 +949,13 @@ def _replay_chain(n_vals: int, n_blocks: int, backend: str,
         items = got
         total_sigs += sum(c.num_sigs() for _, _, c, _ in items)
         t = time.perf_counter()
-        for bid, h, c, parts in items:
-            block = chain[h - 1][0]
-            execution.apply_block(state, None, conns.consensus, block,
-                                  parts.header, execution.MockMempool(),
-                                  check_last_commit=False)
+        with tracing.span("bench.apply", blocks=len(items)):
+            for bid, h, c, parts in items:
+                block = chain[h - 1][0]
+                execution.apply_block(state, None, conns.consensus, block,
+                                      parts.header,
+                                      execution.MockMempool(),
+                                      check_last_commit=False)
         apply_seconds += time.perf_counter() - t
     dt = time.perf_counter() - t0
     prep_pool.shutdown(wait=False)
@@ -749,12 +1009,20 @@ def config4_light_multichain(quick: bool) -> dict:
                 log("[config4] retry budget exhausted; "
                     "reporting best attempt as degraded")
                 break
+            if not BUDGET.allows(_last_fixture_cost(), "config4 retry"):
+                log("[config4] deadline too close for another fixture "
+                    "build; reporting best attempt as degraded")
+                break
             log(f"[config4] degraded run "
                 f"({attempts[-1]['sigs_per_sec']:.0f} sigs/s vs anchor "
                 f"{scalar:.0f}); retrying on a fresh fixture")
             attempts.append(_config4_attempt(quick, salt=salt))
     out = max(attempts, key=lambda r: r["sigs_per_sec"])
     out["attempts"] = len(attempts)
+    # every attempt's rate, not just the winner's: a scoreboard that only
+    # sees the max can't tell a healthy device from one that needed three
+    # tries to land one good run
+    out["attempt_rates"] = [round(a["sigs_per_sec"], 1) for a in attempts]
     out["degraded"] = bool(not quick and out["sigs_per_sec"] < healthy)
     return out
 
@@ -770,6 +1038,7 @@ def _config4_attempt(quick: bool, salt: int) -> dict:
     chunk_h = min(H, 8192)                  # 65536-lane device chunks
     backend = cb.set_backend("tpu")
     rng = np.random.default_rng(4 + salt)
+    t_build0 = time.perf_counter()
     log(f"[config4] building {n_chains} chains x {H} headers x {V} vals "
         f"({n_chains * H * V / 1e6:.1f}M sigs, device-signed)...")
     sign_idx = np.tile(np.arange(V, dtype=np.int32), chunk_h)
@@ -807,6 +1076,10 @@ def _config4_attempt(quick: bool, salt: int) -> dict:
                 raise RuntimeError(f"chain {cid}: bad device sig {i}")
         chains.append((cid.encode(), val_pubs, templates, sigs))
         log(f"[config4]   chain {cid} signed")
+    tracing.RECORDER.record(
+        "bench.fixture_build", tracing._EPOCH_T0 + t_build0,
+        time.perf_counter() - t_build0,
+        {"config": 4, "salt": salt, "chains": n_chains})
     tmpl_idx_chunk = np.repeat(np.arange(chunk_h), V).astype(np.int32)
     idx_chunk = np.tile(np.arange(V), chunk_h).astype(np.int32)
     log("[config4] warm-up (8 table sets + chunk-shape compiles)...")
@@ -888,11 +1161,16 @@ def config3_fastsync(quick: bool) -> dict:
             log("[config3] retry budget exhausted; "
                 "reporting best attempt as degraded")
             break
+        if not BUDGET.allows(_last_fixture_cost(), "config3 retry"):
+            log("[config3] deadline too close for another fixture build; "
+                "reporting best attempt as degraded")
+            break
         log("[config3] device throughput looks degraded "
             f"({res['sigs_per_sec']:.0f} sigs/s vs anchor "
             f"{anchor['sigs_per_sec']:.0f}); retrying on a fresh fixture")
     res = max(attempts, key=lambda r: r["sigs_per_sec"])
     res["attempts"] = len(attempts)
+    res["attempt_rates"] = [round(a["sigs_per_sec"], 1) for a in attempts]
     res["degraded"] = bool(not quick and res["sigs_per_sec"] < healthy)
     res["cpu_pipeline_sigs_per_sec"] = anchor["sigs_per_sec"]
     res["cpu_pipeline_blocks_per_sec"] = anchor["blocks_per_sec"]
@@ -906,13 +1184,32 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--config", type=int, default=None)
+    ap.add_argument("--partial-out",
+                    default=os.environ.get("TM_BENCH_PARTIAL",
+                                           "bench_partial.json"),
+                    help="partial-results JSON, rewritten atomically as "
+                         "each config completes")
+    ap.add_argument("--trace-out",
+                    default=os.environ.get("TM_BENCH_TRACE",
+                                           "bench_trace.json"),
+                    help="Chrome trace-event JSON of the run's flight-"
+                         "recorder spans")
+    ap.add_argument("--budget", type=float,
+                    default=float(os.environ.get("TM_BENCH_BUDGET_S",
+                                                 "0") or 0),
+                    help="wall-clock budget in seconds; retries whose "
+                         "fixture rebuild won't fit are skipped")
     args = ap.parse_args()
 
-    results = {}
-    log(f"[bench] anchoring native CPU scalar rate...")
+    global BUDGET
+    BUDGET = BudgetManager(args.budget)
+    ckpt = BenchCheckpoint(args.partial_out, trace_path=args.trace_out)
+    ckpt.install_signal_handlers()
+
+    log("[bench] anchoring native CPU scalar rate...")
     anchor = native_scalar_rate(300 if args.quick else 1500)
     log(f"[bench] native scalar anchor: {anchor:.0f} sigs/s")
-    results["native_scalar_sigs_per_sec"] = anchor
+    ckpt.record("native_scalar_sigs_per_sec", anchor)
 
     configs = {0: config0_cpu_replay, 1: config1_batch_verify,
                2: config2_merkle_batch, 3: config3_fastsync,
@@ -921,29 +1218,25 @@ def main() -> None:
            else ([1, 3] if args.quick else [0, 1, 2, 3, 4]))
     for c in run:
         try:
-            results[f"config{c}"] = configs[c](args.quick)
+            with tracing.span("bench.config", config=c):
+                res = configs[c](args.quick)
         except Exception as e:
             log(f"[bench] config {c} FAILED: {e}")
             import traceback
             traceback.print_exc(file=sys.stderr)
-            results[f"config{c}"] = {"error": str(e)}
+            res = {"error": str(e)}
+        ckpt.record(f"config{c}", res)
 
     # headline: the north-star replay if it ran, else raw batch verify
-    c3 = results.get("config3", {})
-    c1 = results.get("config1", {})
-    if "sigs_per_sec" in c3:
-        headline = {"metric": "fastsync_replay_commit_sigs_per_sec",
-                    "value": round(c3["sigs_per_sec"], 1),
-                    "unit": "sigs/s",
-                    "vs_baseline": round(c3["sigs_per_sec"] / anchor, 2)}
-    elif "sigs_per_sec" in c1:
-        headline = {"metric": "batch_verify_sigs_per_sec",
-                    "value": round(c1["sigs_per_sec"], 1),
-                    "unit": "sigs/s",
-                    "vs_baseline": round(c1["sigs_per_sec"] / anchor, 2)}
-    else:
-        headline = {"metric": "bench_failed", "value": 0, "unit": "",
-                    "vs_baseline": 0}
+    results = ckpt.results
+    headline = _headline(results)
+    ckpt.flush(final=True)
+    try:
+        tracing.RECORDER.dump(args.trace_out)
+        log(f"[bench] flight-recorder trace written to {args.trace_out} "
+            f"({tracing.RECORDER.total} spans)")
+    except OSError as e:
+        log(f"[bench] trace dump failed: {e}")
     log("[bench] detail: " + json.dumps(results, default=str))
     print(json.dumps(headline), flush=True)
 

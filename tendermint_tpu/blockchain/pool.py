@@ -15,6 +15,7 @@ from __future__ import annotations
 import threading
 import time
 
+from tendermint_tpu.utils import tracing
 from tendermint_tpu.utils.log import get_logger
 
 log = get_logger("blockpool")
@@ -176,6 +177,7 @@ class BlockPool:
             if peer_id not in self._peers:
                 return
         log.info("evicting slow peer", peer=peer_id[:12], reason=reason)
+        tracing.instant("pool.evict", peer=peer_id[:12], reason=reason)
         self.remove_peer(peer_id)
         if self.on_evict is not None:
             self.on_evict(peer_id, reason)
@@ -222,6 +224,8 @@ class BlockPool:
         with self._lock:
             slot = self._slots.pop(height, None)
         if slot is not None:
+            tracing.instant("pool.redo", height=height,
+                            peer=slot.peer_id[:12])
             self._evict(slot.peer_id, f"bad block at height {height}")
             # drop any later blocks that peer delivered: they're suspect
             with self._lock:

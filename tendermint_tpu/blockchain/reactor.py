@@ -29,6 +29,7 @@ from tendermint_tpu.types.part_set import from_data_batched
 from tendermint_tpu.types.validator import (CommitPowerError,
                                             CommitSignatureError,
                                             verify_commits_batched)
+from tendermint_tpu.utils import tracing
 from tendermint_tpu.utils.chaos import DeviceFault
 from tendermint_tpu.utils.log import get_logger
 from tendermint_tpu.utils.metrics import REGISTRY
@@ -65,10 +66,15 @@ class _Lookahead:
 
     def _run(self) -> None:
         try:
-            window, parts_list, items = BlockchainReactor._prepare_window(
-                self._blocks, self.vals_hash)
-            if window:
-                verify_commits_batched(self._vals, self._chain_id, items)
+            with tracing.span("fastsync.lookahead",
+                              first_height=self.first_height,
+                              blocks=len(self._blocks)):
+                window, parts_list, items = \
+                    BlockchainReactor._prepare_window(self._blocks,
+                                                      self.vals_hash)
+                if window:
+                    verify_commits_batched(self._vals, self._chain_id,
+                                           items)
             self.window, self.parts_list, self.items = (window, parts_list,
                                                         items)
         except BaseException as e:
@@ -251,8 +257,11 @@ class BlockchainReactor(Reactor):
             # synchronously so the error/redo paths below stay in charge
         t0 = time.perf_counter()
         if verified is None:
-            window, parts_list, items = self._prepare_window(blocks,
-                                                             vals_hash)
+            with tracing.span("fastsync.prepare",
+                              first_height=blocks[0].height,
+                              blocks=len(blocks) - 1):
+                window, parts_list, items = self._prepare_window(blocks,
+                                                                 vals_hash)
             if not window:
                 # the very next block disagrees with our state's validator
                 # set: the block is bad (or stale) — re-fetch it elsewhere
@@ -261,8 +270,11 @@ class BlockchainReactor(Reactor):
                 self.pool.redo(blocks[0].height)
                 return False
             try:
-                verify_commits_batched(self.state.validators, chain_id,
-                                       items)
+                with tracing.span("fastsync.verify",
+                                  first_height=window[0].height,
+                                  blocks=len(window)):
+                    verify_commits_batched(self.state.validators, chain_id,
+                                           items)
             except DeviceFault as e:
                 # OUR device failed, not the peer: every rung of the
                 # crypto ladder errored out.  Blaming the deliverer here
@@ -305,26 +317,31 @@ class BlockchainReactor(Reactor):
             self._lookahead = _Lookahead(
                 self.state.validators.copy(), chain_id, nxt)
         applied = 0
-        for b, parts, (bid, h, commit) in zip(window, parts_list, items):
-            # store-before-state is the crash-recovery discipline (the
-            # handshake covers store==state+1); but the pool advances only
-            # AFTER a successful apply so an in-process app/WAL fault
-            # re-fetches and re-applies instead of wedging the sync.
-            if self.store.height < b.height:
-                self.store.save_block(b, parts, commit)
-            execution.apply_block(self.state, None, self.proxy, b,
-                                  parts.header, execution.MockMempool(),
-                                  check_last_commit=False)
-            self.pool.pop(1)
-            REGISTRY.blocks_synced.inc()
-            applied += 1
-            new_hash = self.state.validators.hash()
-            if new_hash != vals_hash:
-                # validator set changed: the rest of the window was
-                # verified against a stale set — drop and re-verify
-                log.info("valset changed mid-window; flushing",
-                         height=b.height)
-                break
+        with tracing.span("fastsync.apply", first_height=window[0].height,
+                          blocks=len(window)):
+            for b, parts, (bid, h, commit) in zip(window, parts_list,
+                                                  items):
+                # store-before-state is the crash-recovery discipline (the
+                # handshake covers store==state+1); but the pool advances
+                # only AFTER a successful apply so an in-process app/WAL
+                # fault re-fetches and re-applies instead of wedging the
+                # sync.
+                if self.store.height < b.height:
+                    self.store.save_block(b, parts, commit)
+                execution.apply_block(self.state, None, self.proxy, b,
+                                      parts.header,
+                                      execution.MockMempool(),
+                                      check_last_commit=False)
+                self.pool.pop(1)
+                REGISTRY.blocks_synced.inc()
+                applied += 1
+                new_hash = self.state.validators.hash()
+                if new_hash != vals_hash:
+                    # validator set changed: the rest of the window was
+                    # verified against a stale set — drop and re-verify
+                    log.info("valset changed mid-window; flushing",
+                             height=b.height)
+                    break
         log.debug("synced window", blocks=applied,
                   sigs=sum(len(i[2].precommits) for i in items),
                   verify_seconds=round(dt, 4),

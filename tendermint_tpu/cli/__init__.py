@@ -366,6 +366,37 @@ def cmd_wal_fsck(args) -> int:
     return 1
 
 
+def cmd_trace(args) -> int:
+    """Fetch a running node's flight recorder over RPC and write it as
+    Chrome trace-event JSON (open in Perfetto / chrome://tracing).
+    Requires the node to run with rpc.unsafe = true."""
+    import urllib.request
+    url = args.rpc.rstrip("/")
+    if not url.startswith("http"):
+        url = "http://" + url
+    body = json.dumps({"jsonrpc": "2.0", "id": 1,
+                       "method": "debug_flight_recorder",
+                       "params": {"format": "chrome"}}).encode()
+    req = urllib.request.Request(
+        url, data=body, headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        reply = json.loads(resp.read())
+    if "error" in reply:
+        print(f"rpc error: {reply['error'].get('message')} "
+              "(is rpc.unsafe enabled on the node?)")
+        return 1
+    result = reply["result"]
+    tmp = args.out + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(result["trace"], f)
+    os.replace(tmp, args.out)
+    n = len(result["trace"]["traceEvents"])
+    print(f"wrote {n} trace events to {args.out} "
+          f"(recorder total={result['total']} "
+          f"dropped={result['dropped']})")
+    return 0
+
+
 def cmd_version(args) -> int:
     print(__version__)
     return 0
@@ -463,6 +494,15 @@ def main(argv=None) -> int:
     sp.add_argument("--repair", action="store_true",
                     help="rewrite the log keeping only valid records")
     sp.set_defaults(fn=cmd_wal_fsck)
+
+    sp = sub.add_parser("trace",
+                        help="dump a node's flight recorder as Chrome "
+                             "trace JSON")
+    sp.add_argument("--rpc", default="http://127.0.0.1:26657",
+                    help="node RPC address")
+    sp.add_argument("--out", default="flight_trace.json",
+                    help="output Chrome trace-event JSON path")
+    sp.set_defaults(fn=cmd_trace)
 
     sp = sub.add_parser("version", help="print version")
     sp.set_defaults(fn=cmd_version)

@@ -103,9 +103,13 @@ class ConsensusConfig:
         if g > 1.0:
             # growth^round overflows float for round ~1750 at g=1.5; the
             # cap is reached long before that, so clamp the exponent to
-            # the first round where base*g^r alone exceeds the cap
+            # the first round where base*g^r alone exceeds the cap.
+            # base may legitimately be 0 (a test config that skips a
+            # step instantly) — guard the division so the clamp math
+            # can't ZeroDivisionError, growth then reaches the cap fast
             import math
-            max_r = math.ceil(math.log(max(self.timeout_max / base, 1.0),
+            base_ = max(base, 1e-9)
+            max_r = math.ceil(math.log(max(self.timeout_max / base_, 1.0),
                                        g)) + 1
             t = min(t * g ** min(round_, max_r), self.timeout_max)
         return t

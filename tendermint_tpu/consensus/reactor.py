@@ -150,11 +150,14 @@ class PeerState:
             prs.proposal_pol = None
 
     def init_proposal_block_parts(self, header) -> None:
-        """Sender-side (re)init for catchup gossip (reference
-        `gossipDataRoutine` reactor.go:427-464 re-inits whenever the
-        tracked header differs from the stored block's header).
+        """Sender-side (re)init for catchup gossip.  Reference
+        `gossipDataRoutine` reactor.go:505-510 only LOGS the header
+        mismatch ("peer ProposalBlockPartsHeader mismatch") and sleeps
+        for the next tick — it never re-keys the peer's bitmap.  We
+        deliberately diverge and RESET the bitmap to the stored block's
+        header; the divergence is covered by the stress tier.
 
-        The RESET case matters: a peer that proposed its OWN block for a
+        The reset matters: a peer that proposed its OWN block for a
         later round advertises that proposal, so our model's bitmap
         refers to the peer's round-R partset — using it as the bitmap
         for the COMMITTED block marks parts delivered that the peer

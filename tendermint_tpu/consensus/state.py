@@ -36,6 +36,7 @@ from tendermint_tpu.types import events as ev
 from tendermint_tpu.types.events import EventCache, EventSwitch
 from tendermint_tpu.types.priv_validator import DoubleSignError
 from tendermint_tpu.types.vote import ErrVoteConflict
+from tendermint_tpu.utils import tracing
 from tendermint_tpu.utils.chaos import DeviceFault
 from tendermint_tpu.utils.fail import fail_point
 from tendermint_tpu.utils.log import get_logger
@@ -106,6 +107,7 @@ class ConsensusState:
         self.wal = WAL(wal_path, light=cfg.wal_light) if wal_path else None
         self._replay_mode = False
         self._commit_step_bcast = 0.0   # last CommitStep broadcast
+        self._round_t0 = 0.0            # monotonic start of current round
         # wait-for-txs (create_empty_blocks = false): the mempool's
         # height-gated txs-available notification unblocks enterPropose
         # (reference consensus/state.go:793-801); delivered through the
@@ -447,7 +449,9 @@ class ConsensusState:
         if len(sel) < self.VOTE_MICROBATCH_MIN:
             return set()
         try:
-            ok = batch_verify_vote_sigs(self.state.chain_id, vals, sel)
+            with tracing.span("consensus.vote_microbatch",
+                              height=self.height, lanes=len(sel)):
+                ok = batch_verify_vote_sigs(self.state.chain_id, vals, sel)
         except DeviceFault as e:
             # ladder exhausted mid-burst: "not batched" is a safe answer
             # here (the scalar add_vote path re-verifies), "rejected"
@@ -546,6 +550,9 @@ class ConsensusState:
 
     def _new_step(self, step: int) -> None:
         self.step = step
+        tracing.instant("consensus.step", height=self.height,
+                        round=self.round,
+                        step=STEP_NAMES.get(step, step))
         rs = self._round_step_event()
         self.evsw.fire(ev.NEW_ROUND_STEP, rs)
         self._broadcast(M.NewRoundStepMessage(
@@ -600,6 +607,13 @@ class ConsensusState:
             validators = self.validators.copy()
             validators.increment_accum(round_ - self.round)
             self.validators = validators
+        now = time.monotonic()
+        if self._round_t0 > 0:
+            # previous round's wall clock (failed round -> longer tail;
+            # the histogram's p99 is where round churn becomes visible)
+            REGISTRY.round_seconds_hist.observe(now - self._round_t0)
+        self._round_t0 = now
+        tracing.instant("consensus.round", height=height, round=round_)
         self.round = round_
         self.step = STEP_NEW_ROUND
         REGISTRY.rounds_started.inc()
@@ -925,9 +939,11 @@ class ConsensusState:
 
         state_copy = self.state.copy()
         event_cache = EventCache(self.evsw)
-        execution.apply_block(state_copy, event_cache, self.proxy, block,
-                              parts.header, self.mempool,
-                              tx_indexer=self.tx_indexer)
+        with tracing.span("consensus.apply", height=block.height,
+                          txs=len(block.txs)):
+            execution.apply_block(state_copy, event_cache, self.proxy,
+                                  block, parts.header, self.mempool,
+                                  tx_indexer=self.tx_indexer)
         fail_point("consensus.finalizeCommit.applied")
         event_cache.fire(ev.NEW_BLOCK, block)
         event_cache.fire(ev.NEW_BLOCK_HEADER, block.header)

@@ -16,6 +16,7 @@ import os
 import struct
 import zlib
 
+from tendermint_tpu.utils import tracing
 from tendermint_tpu.utils.log import get_logger
 
 log = get_logger("wal")
@@ -44,17 +45,22 @@ class WAL:
         self._f.write(struct.pack(">II", len(body), crc) + body)
 
     def save_message(self, payload: bytes) -> None:
-        self._write(REC_MESSAGE, payload)
-        self._sync()
+        with tracing.span("wal.write", kind="message",
+                          bytes=len(payload)):
+            self._write(REC_MESSAGE, payload)
+            self._sync()
 
     def save_timeout(self, height: int, round_: int, step: int) -> None:
-        self._write(REC_TIMEOUT, struct.pack(">QIB", height, round_, step))
-        self._sync()
+        with tracing.span("wal.write", kind="timeout", height=height):
+            self._write(REC_TIMEOUT,
+                        struct.pack(">QIB", height, round_, step))
+            self._sync()
 
     def write_end_height(self, height: int) -> None:
         """Reference `:97-103`: marks height as irreversibly committed."""
-        self._write(REC_ENDHEIGHT, struct.pack(">Q", height))
-        self._sync()
+        with tracing.span("wal.write", kind="end_height", height=height):
+            self._write(REC_ENDHEIGHT, struct.pack(">Q", height))
+            self._sync()
 
     def _sync(self) -> None:
         self._f.flush()

@@ -22,6 +22,7 @@ from typing import Protocol
 import numpy as np
 
 from tendermint_tpu.crypto import pure_ed25519 as _ref
+from tendermint_tpu.utils import tracing
 from tendermint_tpu.utils.metrics import REGISTRY
 
 MIN_BUCKET = 16
@@ -139,19 +140,23 @@ class TpuBackend:
             sigs = np.concatenate([sigs, np.repeat(sigs[:1], pad, 0)])
         jnp = self._jnp
         t0 = time.perf_counter()
-        out = self._dev.verify_batch(jnp.asarray(pubkeys), jnp.asarray(msgs),
-                                     jnp.asarray(sigs))
-        out = np.asarray(out)
+        with tracing.span("verify.batch", lanes=n, bucket=b):
+            out = self._dev.verify_batch(jnp.asarray(pubkeys),
+                                         jnp.asarray(msgs),
+                                         jnp.asarray(sigs))
+            out = np.asarray(out)
         dt = time.perf_counter() - t0
         # sync call: dispatch and wait are one interval — record it under
         # both summaries so they stay comparable with the async path
         # (which records the wait alone in step, full wall in dispatch)
         REGISTRY.device_step_seconds.observe(dt)
         REGISTRY.device_dispatch_seconds.observe(dt)
+        REGISTRY.device_step_hist.observe(dt)
         REGISTRY.sigs_requested.inc(n)
         REGISTRY.sigs_verified.inc(int(out[:n].sum()))
         REGISTRY.verify_batches.inc()
         REGISTRY.batch_occupancy.observe(n / b)
+        REGISTRY.batch_occupancy_hist.observe(n / b)
         return out[:n]
 
     def _set_tables(self, set_key: bytes, val_pubs: np.ndarray) -> tuple:
@@ -459,10 +464,11 @@ class TpuBackend:
                                      np.uint8)])
         jnp = self._jnp
         t0 = time.perf_counter()
-        dev_out = self._dev.verify_grouped_templated_jit(
-            tbl, pub_ok, vp_dev, jnp.asarray(val_idx.astype(np.int32)),
-            jnp.asarray(tmpl_idx.astype(np.int32)),
-            jnp.asarray(templates), jnp.asarray(sigs), self._base_tbl)
+        with tracing.span("verify.dispatch", lanes=n, bucket=b):
+            dev_out = self._dev.verify_grouped_templated_jit(
+                tbl, pub_ok, vp_dev, jnp.asarray(val_idx.astype(np.int32)),
+                jnp.asarray(tmpl_idx.astype(np.int32)),
+                jnp.asarray(templates), jnp.asarray(sigs), self._base_tbl)
 
         def collect() -> np.ndarray:
             # time only the wait-for-result here: a pipelined caller does
@@ -471,14 +477,17 @@ class TpuBackend:
             # device-step metric upward (dispatch-to-collect wall is the
             # caller's pipeline depth, not the device's step time)
             t1 = time.perf_counter()
-            out = np.asarray(dev_out)
+            with tracing.span("verify.collect", lanes=n, bucket=b):
+                out = np.asarray(dev_out)
             now = time.perf_counter()
             REGISTRY.device_step_seconds.observe(now - t1)
             REGISTRY.device_dispatch_seconds.observe(now - t0)
+            REGISTRY.device_step_hist.observe(now - t1)
             REGISTRY.sigs_requested.inc(n)
             REGISTRY.sigs_verified.inc(int(out[:n].sum()))
             REGISTRY.verify_batches.inc()
             REGISTRY.batch_occupancy.observe(n / b)
+            REGISTRY.batch_occupancy_hist.observe(n / b)
             return out[:n]
 
         return collect
@@ -533,9 +542,11 @@ class TpuBackend:
                 [templates,
                  np.zeros((tb - t, templates.shape[1]), np.uint8)])
         jnp = self._jnp
-        out = np.asarray(self._dev.sign_grouped_templated_jit(
-            a_dev, pre_dev, pubs_dev, jnp.asarray(val_idx),
-            jnp.asarray(tmpl_idx), jnp.asarray(templates), self._base_tbl))
+        with tracing.span("sign.batch", lanes=n, bucket=b):
+            out = np.asarray(self._dev.sign_grouped_templated_jit(
+                a_dev, pre_dev, pubs_dev, jnp.asarray(val_idx),
+                jnp.asarray(tmpl_idx), jnp.asarray(templates),
+                self._base_tbl))
         return out[:n]
 
     def precompile_for_validators(self, vals) -> None:
@@ -628,23 +639,26 @@ class TpuBackend:
             sigs = np.concatenate([sigs, np.repeat(sigs[:1], pad, 0)])
         jnp = self._jnp
         t0 = time.perf_counter()
-        if self._mesh_eligible(b):
-            fn = self._sharded_fn(tbl.shape[2], msgs.shape[-1])
-            out = fn(tbl, pub_ok, val_idx.astype(np.int32), pubkeys,
-                     msgs, sigs)
-        else:
-            out = self._dev.verify_grouped_jit(
-                tbl, pub_ok, jnp.asarray(val_idx.astype(np.int32)),
-                jnp.asarray(pubkeys), jnp.asarray(msgs), jnp.asarray(sigs),
-                self._base_tbl)
-        out = np.asarray(out)
+        with tracing.span("verify.grouped", lanes=n, bucket=b):
+            if self._mesh_eligible(b):
+                fn = self._sharded_fn(tbl.shape[2], msgs.shape[-1])
+                out = fn(tbl, pub_ok, val_idx.astype(np.int32), pubkeys,
+                         msgs, sigs)
+            else:
+                out = self._dev.verify_grouped_jit(
+                    tbl, pub_ok, jnp.asarray(val_idx.astype(np.int32)),
+                    jnp.asarray(pubkeys), jnp.asarray(msgs),
+                    jnp.asarray(sigs), self._base_tbl)
+            out = np.asarray(out)
         dt = time.perf_counter() - t0
         REGISTRY.device_step_seconds.observe(dt)      # sync: step ==
         REGISTRY.device_dispatch_seconds.observe(dt)  # dispatch interval
+        REGISTRY.device_step_hist.observe(dt)
         REGISTRY.sigs_requested.inc(n)
         REGISTRY.sigs_verified.inc(int(out[:n].sum()))
         REGISTRY.verify_batches.inc()
         REGISTRY.batch_occupancy.observe(n / b)
+        REGISTRY.batch_occupancy_hist.observe(n / b)
         return out[:n]
 
 

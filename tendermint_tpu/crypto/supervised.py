@@ -40,6 +40,7 @@ from concurrent.futures import TimeoutError as FutureTimeout
 
 import numpy as np
 
+from tendermint_tpu.utils import tracing
 from tendermint_tpu.utils.chaos import CryptoChaos, DeviceFault
 from tendermint_tpu.utils.log import get_logger
 from tendermint_tpu.utils.metrics import REGISTRY, Summary
@@ -175,6 +176,7 @@ class SupervisedBackend:
             rung.faults += 1
             rung.consecutive_faults += 1
             REGISTRY.crypto_device_faults.inc()
+            REGISTRY.crypto_rung_faults.labels(rung.name).inc()
             tripped = False
             if rung.state == HALF_OPEN:
                 # failed probe: straight back to OPEN, fresh cooldown
@@ -227,26 +229,28 @@ class SupervisedBackend:
 
         t0 = time.perf_counter()
         rung.calls += 1
-        if not rung.is_device:
-            out = run()
-        else:
-            try:
-                if self.call_timeout_s > 0:
-                    fut = self._pool.submit(run)
-                    try:
-                        out = fut.result(timeout=self.call_timeout_s)
-                    except FutureTimeout:
-                        fut.cancel()
-                        raise DeviceFault(
-                            f"{rung.name}.{method} exceeded the "
-                            f"{self.call_timeout_s}s call timeout")
-                else:
-                    out = run()
-            except DeviceFault:
-                raise
-            except Exception as e:
-                raise DeviceFault(
-                    f"{rung.name}.{method} failed: {e!r}") from e
+        REGISTRY.crypto_rung_calls.labels(rung.name).inc()
+        with tracing.span("crypto.call", rung=rung.name, method=method):
+            if not rung.is_device:
+                out = run()
+            else:
+                try:
+                    if self.call_timeout_s > 0:
+                        fut = self._pool.submit(run)
+                        try:
+                            out = fut.result(timeout=self.call_timeout_s)
+                        except FutureTimeout:
+                            fut.cancel()
+                            raise DeviceFault(
+                                f"{rung.name}.{method} exceeded the "
+                                f"{self.call_timeout_s}s call timeout")
+                    else:
+                        out = run()
+                except DeviceFault:
+                    raise
+                except Exception as e:
+                    raise DeviceFault(
+                        f"{rung.name}.{method} failed: {e!r}") from e
         rung.latency.observe(time.perf_counter() - t0)
         return out
 
@@ -390,6 +394,7 @@ class SupervisedBackend:
             return collect
 
         rung.calls += 1
+        REGISTRY.crypto_rung_calls.labels(rung.name).inc()
         try:
             if self.call_timeout_s > 0 and rung.is_device:
                 fut = self._pool.submit(run)

@@ -91,6 +91,7 @@ class Routes:
                 "debug_stacks": self.debug_stacks,
                 "debug_trace_start": self.debug_trace_start,
                 "debug_trace_stop": self.debug_trace_stop,
+                "debug_flight_recorder": self.debug_flight_recorder,
             })
 
     # -- info routes ----------------------------------------------------
@@ -154,13 +155,23 @@ class Routes:
 
     def validators(self, params: dict) -> dict:
         vs = self.node.state.validators
+        # snapshot the accum vector under the consensus lock: the commit
+        # path rotates _accums in place, and an unlocked element-by-element
+        # read can interleave with a rotation and report a mix of pre- and
+        # post-increment priorities
+        mtx = getattr(getattr(self.node, "consensus", None), "_mtx", None)
+        if mtx is not None:
+            with mtx:
+                accums = vs._accums.copy()
+        else:
+            accums = vs._accums.copy()
         return {
             "block_height": self.node.state.last_block_height,
             "validators": [
                 {"address": _hexb(v.address),
                  "pub_key": _hexb(v.pub_key.bytes_),
                  "voting_power": v.voting_power,
-                 "accum": vs.accum_of(i)}
+                 "accum": int(accums[i])}
                 for i, v in enumerate(vs.validators)
             ],
         }
@@ -237,6 +248,26 @@ class Routes:
     def debug_trace_stop(self, params: dict) -> dict:
         from tendermint_tpu.utils import trace
         return {"dir": trace.stop_device_trace()}
+
+    def debug_flight_recorder(self, params: dict) -> dict:
+        """Dump the in-process flight recorder.  format="chrome" returns
+        the Chrome trace-event JSON (load in Perfetto / chrome://tracing);
+        the default "spans" form is the raw oldest-first span list.
+        clear=true empties the ring after the dump."""
+        from tendermint_tpu.utils import tracing
+        rec = tracing.RECORDER
+        fmt = str(params.get("format", "spans"))
+        if fmt == "chrome":
+            out = {"trace": rec.to_chrome_trace()}
+        elif fmt == "spans":
+            out = {"spans": rec.snapshot()}
+        else:
+            raise ValueError("format must be 'spans' or 'chrome'")
+        out.update({"total": rec.total, "dropped": rec.dropped,
+                    "capacity": rec.capacity})
+        if str(params.get("clear", "")).lower() in ("1", "true", "yes"):
+            rec.clear()
+        return out
 
     def net_info(self, params: dict) -> dict:
         sw = self.node.switch

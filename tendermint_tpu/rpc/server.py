@@ -14,6 +14,7 @@ from urllib.parse import parse_qsl, urlparse
 
 from tendermint_tpu.rpc.routes import Routes
 from tendermint_tpu.rpc import websocket as ws
+from tendermint_tpu.utils import metrics
 
 
 class RPCServer:
@@ -44,6 +45,18 @@ class RPCServer:
                 method = parsed.path.strip("/")
                 if method == "websocket":
                     self._upgrade_websocket()
+                    return
+                if method == "metrics":
+                    # Prometheus text exposition — plain text, not
+                    # JSON-RPC, so it bypasses the method table
+                    data = metrics.prometheus_text().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4; "
+                                     "charset=utf-8")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
                     return
                 if method == "":
                     self._respond(200, {

@@ -90,6 +90,119 @@ class Summary:
         return self._min
 
 
+class Histogram:
+    """Fixed-bucket histogram: counts per upper bound plus sum/count, the
+    Prometheus histogram layout.  Unlike `Summary` (decayed mean — good
+    for steering heuristics, blind to tails) this answers the questions a
+    benchmark scoreboard asks: p50/p90/p99 device step latency, batch
+    occupancy distribution, round duration spread.  Quantiles are the
+    standard bucket interpolation — exact bucket, linear within it."""
+
+    # latency bounds (seconds): 100us .. 10s, the device-call range
+    LATENCY_BOUNDS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+                      0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                      10.0)
+    # ratio bounds: batch occupancy lives in (0, 1]
+    RATIO_BOUNDS = (0.05, 0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9,
+                    0.95, 1.0)
+    # wall-clock bounds (seconds): consensus round durations
+    DURATION_BOUNDS = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                       10.0, 30.0, 60.0)
+
+    __slots__ = ("bounds", "_counts", "_sum", "_n", "_lock")
+
+    def __init__(self, bounds=LATENCY_BOUNDS):
+        self.bounds = tuple(bounds)
+        if list(self.bounds) != sorted(self.bounds) or not self.bounds:
+            raise ValueError("histogram bounds must be sorted, non-empty")
+        self._counts = [0] * (len(self.bounds) + 1)   # +1 = +Inf overflow
+        self._sum = 0.0
+        self._n = 0
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        i = 0
+        for i, b in enumerate(self.bounds):
+            if v <= b:
+                break
+        else:
+            i = len(self.bounds)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += v
+            self._n += 1
+
+    @property
+    def count(self) -> int:
+        return self._n
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def buckets(self) -> list[tuple[float, int]]:
+        """(upper_bound, CUMULATIVE count) per bucket, +Inf last — the
+        exposition-format shape."""
+        with self._lock:
+            counts = list(self._counts)
+        out, cum = [], 0
+        for b, c in zip(self.bounds, counts):
+            cum += c
+            out.append((b, cum))
+        out.append((float("inf"), cum + counts[-1]))
+        return out
+
+    def quantile(self, q: float) -> float:
+        """Estimated q-quantile (0 < q <= 1).  0.0 when empty; values in
+        the overflow bucket report the highest finite bound (the same
+        saturation Prometheus' histogram_quantile applies)."""
+        with self._lock:
+            counts = list(self._counts)
+            n = self._n
+        if n == 0:
+            return 0.0
+        target = q * n
+        cum = 0
+        lo = 0.0
+        for b, c in zip(self.bounds, counts):
+            if cum + c >= target and c > 0:
+                return lo + (b - lo) * (target - cum) / c
+            cum += c
+            lo = b
+        return self.bounds[-1]
+
+    def snapshot(self) -> dict:
+        return {"count": self.count, "sum": round(self._sum, 6),
+                "p50": round(self.quantile(0.50), 6),
+                "p90": round(self.quantile(0.90), 6),
+                "p99": round(self.quantile(0.99), 6)}
+
+
+class CounterVec:
+    """A counter family keyed by one label (e.g. the crypto ladder rung):
+    `vec.labels("tpu").inc()`.  Cells are created on first touch so a
+    scrape sees exactly the rungs that have served calls — a demotion to
+    `native` appears as a new labeled series the moment it happens."""
+
+    __slots__ = ("label", "_cells", "_lock")
+
+    def __init__(self, label: str):
+        self.label = label
+        self._cells: dict[str, Counter] = {}
+        self._lock = threading.Lock()
+
+    def labels(self, value: str) -> Counter:
+        with self._lock:
+            c = self._cells.get(value)
+            if c is None:
+                c = self._cells[value] = Counter()
+            return c
+
+    def items(self) -> list[tuple[str, int]]:
+        with self._lock:
+            return [(k, c.value) for k, c in sorted(self._cells.items())]
+
+
 class Registry:
     def __init__(self):
         self._start = time.time()
@@ -106,6 +219,11 @@ class Registry:
         self.device_dispatch_seconds = Summary()  # dispatch->result wall
         #   (includes overlapped host work in pipelined callers)
         self.table_build_seconds = Summary()  # comb-table builds (per set)
+        # tail-aware distributions (the Summary twins above keep the
+        # steering heuristics; these feed the /metrics scrape + p99s)
+        self.device_step_hist = Histogram(Histogram.LATENCY_BOUNDS)
+        self.batch_occupancy_hist = Histogram(Histogram.RATIO_BOUNDS)
+        self.round_seconds_hist = Histogram(Histogram.DURATION_BOUNDS)
         # supervised-crypto plane (crypto/supervised.py)
         self.crypto_device_faults = Counter()   # faults seen on any rung
         self.crypto_fallback_calls = Counter()  # calls served below rung 0
@@ -113,6 +231,11 @@ class Registry:
         self.crypto_breaker_recoveries = Counter()  # HALF-OPEN -> CLOSED
         self.crypto_spot_checks = Counter()
         self.crypto_spot_check_mismatches = Counter()
+        # per-rung call/fault counts, labeled by ladder rung
+        # (tpu/native/python): a SupervisedBackend demotion shows up on a
+        # scrape as the lower rung's calls series starting to move
+        self.crypto_rung_calls = CounterVec("rung")
+        self.crypto_rung_faults = CounterVec("rung")
         # live-vote micro-batching (receive-loop burst ingestion)
         self.vote_microbatches = Counter()
         self.vote_microbatch_lanes = Counter()
@@ -154,6 +277,11 @@ class Registry:
             "peers": self.peers.value,
             "p2p_msgs_sent": self.msgs_sent.value,
             "p2p_msgs_received": self.msgs_received.value,
+            "device_step_seconds": self.device_step_hist.snapshot(),
+            "batch_occupancy": self.batch_occupancy_hist.snapshot(),
+            "round_seconds": self.round_seconds_hist.snapshot(),
+            "crypto_rung_calls": dict(self.crypto_rung_calls.items()),
+            "crypto_rung_faults": dict(self.crypto_rung_faults.items()),
         }
 
 
@@ -162,3 +290,53 @@ REGISTRY = Registry()
 
 def snapshot() -> dict:
     return REGISTRY.snapshot()
+
+
+# -- Prometheus text exposition (format version 0.0.4) ----------------------
+
+_PROM_PREFIX = "tendermint_"
+
+
+def _prom_f(v: float) -> str:
+    """Prometheus float rendering: +Inf spelled out, no exponent noise."""
+    if v == float("inf"):
+        return "+Inf"
+    return repr(float(v)) if isinstance(v, float) else str(v)
+
+
+def prometheus_text(registry: Registry | None = None) -> str:
+    """The whole registry in the Prometheus text exposition format,
+    served at GET /metrics by the RPC server.  Instruments map by type:
+    Counter -> counter, Gauge/Summary -> gauge(s), Histogram -> the
+    _bucket{le=}/_sum/_count triple, CounterVec -> one labeled series
+    per cell."""
+    r = registry if registry is not None else REGISTRY
+    lines: list[str] = []
+    for attr, inst in vars(r).items():
+        if attr.startswith("_"):
+            continue
+        name = _PROM_PREFIX + attr
+        if isinstance(inst, Counter):
+            lines += [f"# TYPE {name} counter", f"{name} {inst.value}"]
+        elif isinstance(inst, Gauge):
+            lines += [f"# TYPE {name} gauge", f"{name} {_prom_f(inst.value)}"]
+        elif isinstance(inst, Summary):
+            lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name}{{stat=\"mean\"}} {_prom_f(inst.mean)}")
+            lines.append(f"{name}{{stat=\"min\"}} {_prom_f(inst.min)}")
+            lines.append(f"{name}_count {inst.count}")
+        elif isinstance(inst, Histogram):
+            lines.append(f"# TYPE {name} histogram")
+            for le, cum in inst.buckets():
+                lines.append(f"{name}_bucket{{le=\"{_prom_f(le)}\"}} {cum}")
+            lines.append(f"{name}_sum {_prom_f(inst.sum)}")
+            lines.append(f"{name}_count {inst.count}")
+        elif isinstance(inst, CounterVec):
+            lines.append(f"# TYPE {name} counter")
+            for label_value, v in inst.items():
+                lines.append(
+                    f"{name}{{{inst.label}=\"{label_value}\"}} {v}")
+    lines.append(f"# TYPE {_PROM_PREFIX}uptime_seconds gauge")
+    lines.append(f"{_PROM_PREFIX}uptime_seconds "
+                 f"{_prom_f(round(time.time() - r._start, 3))}")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,175 @@
+"""Flight recorder: a thread-safe ring buffer of timed spans.
+
+The XPlane capture (`utils/trace.py start_device_trace`) answers "what
+did the DEVICE do" at kernel granularity, but only while an operator has
+a capture running.  The flight recorder is the complement: an
+always-on, bounded record of what the HOST planes did — consensus step
+transitions, device batch dispatch/collect, WAL writes, fast-sync pool
+events, bench fixture/replay phases — cheap enough to leave recording
+in production (one lock + one list store per span) and dumpable after
+the fact, like an aircraft FDR.
+
+Spans are written with the context manager::
+
+    with span("verify.dispatch", height=h, lanes=n):
+        ...
+
+or, for point events with no duration, ``instant("pool.evict", ...)``.
+
+The buffer is a fixed-capacity ring (TM_FLIGHT_RECORDER_CAP, default
+16384 spans): old spans are overwritten, never reallocated, so the
+recorder's footprint is constant no matter how long the node runs.
+`to_chrome_trace()` renders the Chrome trace-event JSON format that
+Perfetto / chrome://tracing / TensorBoard all load, so a flight-recorder
+dump and an XPlane capture can be eyeballed side by side.
+
+Served by the `debug_flight_recorder` RPC route (`rpc/routes.py`) and
+the `trace` CLI subcommand; the bench harness dumps one per run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+
+# epoch anchor for perf_counter timestamps: spans carry wall-clock start
+# times (so traces from different processes line up) but durations from
+# the monotonic clock (so an NTP step mid-span cannot go negative)
+_EPOCH_T0 = time.time() - time.perf_counter()
+
+PH_SPAN = "X"        # Chrome "complete" event (ts + dur)
+PH_INSTANT = "i"     # Chrome "instant" event
+
+
+class FlightRecorder:
+    """Fixed-capacity ring of span records, oldest overwritten first.
+
+    A record is the tuple (name, ph, ts_s, dur_s, tid, tname, args):
+    wall-clock start, monotonic duration, originating thread.  Tuples
+    (not dicts) keep the hot-path allocation to one object."""
+
+    def __init__(self, capacity: int = 16384):
+        if capacity < 1:
+            raise ValueError("flight recorder capacity must be >= 1")
+        self.capacity = capacity
+        self._buf: list = [None] * capacity
+        self._head = 0                    # next write slot
+        self._total = 0                   # spans ever recorded
+        self._lock = threading.Lock()
+
+    # -- recording -------------------------------------------------------
+    def record(self, name: str, ts_s: float, dur_s: float,
+               args: dict | None = None, ph: str = PH_SPAN) -> None:
+        t = threading.current_thread()
+        rec = (name, ph, ts_s, dur_s, t.ident or 0, t.name, args or None)
+        with self._lock:
+            self._buf[self._head] = rec
+            self._head = (self._head + 1) % self.capacity
+            self._total += 1
+
+    @contextmanager
+    def span(self, name: str, **args):
+        """Time a block; the span is recorded even when the block raises
+        (a span that vanishes on failure hides exactly the interesting
+        case), with error=<type> appended to its args."""
+        p0 = time.perf_counter()
+        try:
+            yield
+        except BaseException as e:
+            args = {**args, "error": type(e).__name__}
+            raise
+        finally:
+            self.record(name, _EPOCH_T0 + p0, time.perf_counter() - p0,
+                        args)
+
+    def instant(self, name: str, **args) -> None:
+        self.record(name, _EPOCH_T0 + time.perf_counter(), 0.0, args,
+                    ph=PH_INSTANT)
+
+    # -- reading ---------------------------------------------------------
+    def snapshot(self) -> list[dict]:
+        """Spans oldest-first as dicts (RPC / CLI serialization form)."""
+        with self._lock:
+            if self._total >= self.capacity:
+                recs = self._buf[self._head:] + self._buf[:self._head]
+            else:
+                recs = self._buf[:self._head]
+        return [{"name": n, "ph": ph, "ts": ts, "dur": dur,
+                 "tid": tid, "thread": tname,
+                 **({"args": args} if args else {})}
+                for rec in recs if rec is not None
+                for (n, ph, ts, dur, tid, tname, args) in (rec,)]
+
+    def last(self, name: str) -> dict | None:
+        """Most recent span with `name` (bench's budget manager reads the
+        last fixture-build cost here), or None."""
+        for rec in reversed(self.snapshot()):
+            if rec["name"] == name:
+                return rec
+        return None
+
+    @property
+    def total(self) -> int:
+        return self._total
+
+    @property
+    def dropped(self) -> int:
+        return max(0, self._total - self.capacity)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buf = [None] * self.capacity
+            self._head = 0
+            self._total = 0
+
+    # -- export ----------------------------------------------------------
+    def to_chrome_trace(self) -> dict:
+        """Chrome trace-event JSON (the format Perfetto, chrome://tracing
+        and TensorBoard's trace viewer load): one "X" complete event per
+        span (ts/dur in MICROseconds), "i" instants, plus one "M"
+        thread_name metadata event per thread seen."""
+        pid = os.getpid()
+        events = []
+        threads: dict[int, str] = {}
+        for rec in self.snapshot():
+            tid = rec["tid"]
+            threads.setdefault(tid, rec["thread"])
+            ev = {"name": rec["name"], "ph": rec["ph"], "pid": pid,
+                  "tid": tid, "ts": rec["ts"] * 1e6}
+            if rec["ph"] == PH_SPAN:
+                ev["dur"] = rec["dur"] * 1e6
+            else:
+                ev["s"] = "t"            # instant scope: thread
+            if "args" in rec:
+                ev["args"] = rec["args"]
+            events.append(ev)
+        for tid, tname in threads.items():
+            events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                           "tid": tid, "args": {"name": tname}})
+        return {"traceEvents": events, "displayTimeUnit": "ms",
+                "otherData": {"recorder_total": self._total,
+                              "recorder_dropped": self.dropped}}
+
+    def dump(self, path: str) -> str:
+        """Atomically write the Chrome trace JSON to `path` (tmp +
+        rename: a dump interrupted by the very signal that triggered it
+        must not leave a truncated file)."""
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.to_chrome_trace(), f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        return path
+
+
+RECORDER = FlightRecorder(
+    int(os.environ.get("TM_FLIGHT_RECORDER_CAP", "16384")))
+
+span = RECORDER.span
+instant = RECORDER.instant

@@ -84,6 +84,90 @@ def test_backend_updates_global_metrics():
     assert metrics.REGISTRY.sigs_requested.value == before + 1
 
 
+def test_histogram_bucket_math():
+    h = metrics.Histogram(bounds=(1.0, 2.0, 4.0))
+    for v in (0.5, 1.5, 1.6, 3.0, 100.0):
+        h.observe(v)
+    assert h.count == 5
+    import pytest
+    assert h.sum == pytest.approx(106.6)
+    # cumulative per bound, +Inf last
+    assert h.buckets() == [(1.0, 1), (2.0, 3), (4.0, 4),
+                           (float("inf"), 5)]
+
+
+def test_histogram_quantiles():
+    h = metrics.Histogram(bounds=(1.0, 2.0, 4.0))
+    assert h.quantile(0.5) == 0.0            # empty histogram
+    for _ in range(10):
+        h.observe(1.5)                       # all mass in (1, 2]
+    # interpolation stays inside the populated bucket
+    assert 1.0 <= h.quantile(0.5) <= 2.0
+    assert 1.0 <= h.quantile(0.99) <= 2.0
+    h.observe(50.0)                          # overflow bucket
+    # quantiles saturating into +Inf report the highest finite bound
+    assert h.quantile(1.0) == 4.0
+    snap = h.snapshot()
+    assert snap["count"] == 11
+    assert snap["p50"] <= snap["p90"] <= snap["p99"]
+
+
+def test_histogram_rejects_unsorted_bounds():
+    import pytest
+    with pytest.raises(ValueError):
+        metrics.Histogram(bounds=(2.0, 1.0))
+    with pytest.raises(ValueError):
+        metrics.Histogram(bounds=())
+
+
+def test_counter_vec_labels():
+    v = metrics.CounterVec("rung")
+    v.labels("tpu").inc()
+    v.labels("tpu").inc(2)
+    v.labels("native").inc()
+    assert v.items() == [("native", 1), ("tpu", 3)]
+
+
+def test_registry_snapshot_has_histograms_and_rungs():
+    r = metrics.Registry()
+    r.device_step_hist.observe(0.002)
+    r.crypto_rung_calls.labels("tpu").inc(4)
+    snap = r.snapshot()
+    assert snap["device_step_seconds"]["count"] == 1
+    assert snap["round_seconds"]["count"] == 0
+    assert snap["crypto_rung_calls"] == {"tpu": 4}
+
+
+def test_prometheus_text_exposition():
+    """GET /metrics payload: the 0.0.4 text format — TYPE lines, the
+    cumulative _bucket/_sum/_count histogram triple with le="+Inf", and
+    one labeled series per CounterVec cell."""
+    r = metrics.Registry()
+    r.blocks_committed.inc(3)
+    r.peers.set(2)
+    r.device_step_hist.observe(0.0002)
+    r.device_step_hist.observe(99.0)         # overflow bucket
+    r.crypto_rung_calls.labels("tpu").inc(5)
+    r.crypto_rung_calls.labels("native").inc()
+    text = metrics.prometheus_text(r)
+    lines = text.splitlines()
+    assert text.endswith("\n")
+    assert "# TYPE tendermint_blocks_committed counter" in lines
+    assert "tendermint_blocks_committed 3" in lines
+    assert "tendermint_peers 2" in lines
+    assert "# TYPE tendermint_device_step_hist histogram" in lines
+    assert 'tendermint_device_step_hist_bucket{le="+Inf"} 2' in lines
+    assert "tendermint_device_step_hist_count 2" in lines
+    bucket_counts = [int(ln.rsplit(" ", 1)[1]) for ln in lines
+                     if ln.startswith(
+                         "tendermint_device_step_hist_bucket")]
+    assert bucket_counts == sorted(bucket_counts)   # cumulative
+    assert 'tendermint_crypto_rung_calls{rung="tpu"} 5' in lines
+    assert 'tendermint_crypto_rung_calls{rung="native"} 1' in lines
+    assert any(ln.startswith("tendermint_uptime_seconds ")
+               for ln in lines)
+
+
 def test_debug_stacks_and_trace_hooks():
     """pprof-analog debug surface: thread stacks + device trace guards."""
     from tendermint_tpu.utils import trace

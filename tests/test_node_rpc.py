@@ -150,3 +150,63 @@ def test_unsafe_routes_gated(node, client):
         assert node.mempool.size() == 0
     finally:
         node.config.rpc.unsafe = False
+
+
+def test_metrics_endpoint(node):
+    """GET /metrics serves the Prometheus text exposition with live
+    instrument values — a committed block must show in the counter and
+    the histogram triple must be present."""
+    import urllib.request
+    addr = node.rpc_server.addr
+    with urllib.request.urlopen(f"{addr}/metrics") as r:
+        assert r.status == 200
+        assert r.headers["Content-Type"].startswith("text/plain")
+        text = r.read().decode()
+    lines = text.splitlines()
+    committed = [ln for ln in lines
+                 if ln.startswith("tendermint_blocks_committed ")]
+    assert committed and int(committed[0].split()[1]) >= 1
+    assert "# TYPE tendermint_round_seconds_hist histogram" in lines
+    assert any('_bucket{le="+Inf"}' in ln for ln in lines)
+    assert any(ln.startswith("tendermint_uptime_seconds") for ln in lines)
+
+
+def test_debug_flight_recorder_route(node, client):
+    """The flight recorder is an unsafe-gated route: absent by default,
+    and when enabled it round-trips both the raw span list and the
+    Chrome trace form of the same recorder."""
+    from tendermint_tpu.rpc.routes import Routes
+    from tendermint_tpu.utils import tracing
+    with pytest.raises(RPCError, match="unknown method"):
+        client.call("debug_flight_recorder")
+    node.config.rpc.unsafe = True
+    try:
+        r = Routes(node)
+        assert "debug_flight_recorder" in r.table
+        tracing.RECORDER.instant("test.marker", k=1)
+        out = r.debug_flight_recorder({})
+        assert out["total"] >= 1
+        assert out["capacity"] == tracing.RECORDER.capacity
+        names = [s["name"] for s in out["spans"]]
+        assert "test.marker" in names
+        # a live node records consensus activity through the recorder
+        assert any(n.startswith(("consensus.", "wal.")) for n in names)
+        chrome = r.debug_flight_recorder({"format": "chrome"})
+        evs = chrome["trace"]["traceEvents"]
+        assert any(e["name"] == "test.marker" for e in evs)
+        assert any(e["ph"] == "M" for e in evs)
+        with pytest.raises(ValueError, match="format"):
+            r.debug_flight_recorder({"format": "xml"})
+    finally:
+        node.config.rpc.unsafe = False
+
+
+def test_validators_route_accum_snapshot(node, client):
+    """/validators reports a consistent accum snapshot taken under the
+    consensus lock; with one validator the priority must always be the
+    post-rotation value 0 no matter when the scrape lands."""
+    for _ in range(3):
+        vals = client.validators()
+        (v,) = vals["validators"]
+        assert v["accum"] == 0
+        assert v["voting_power"] == 10

@@ -1,0 +1,144 @@
+"""Flight recorder tests: ring semantics, span/instant recording, and
+the Chrome trace-event export schema (utils/tracing.py)."""
+
+import json
+import os
+import threading
+
+import pytest
+
+from tendermint_tpu.utils.tracing import (PH_INSTANT, PH_SPAN,
+                                          FlightRecorder)
+
+
+def test_ring_overflow_keeps_newest_in_order():
+    rec = FlightRecorder(capacity=4)
+    for i in range(6):
+        rec.record(f"ev{i}", ts_s=float(i), dur_s=0.1)
+    snap = rec.snapshot()
+    assert [s["name"] for s in snap] == ["ev2", "ev3", "ev4", "ev5"]
+    assert rec.total == 6
+    assert rec.dropped == 2
+
+
+def test_snapshot_before_wrap_is_oldest_first():
+    rec = FlightRecorder(capacity=8)
+    for i in range(3):
+        rec.record(f"ev{i}", ts_s=float(i), dur_s=0.0)
+    assert [s["name"] for s in rec.snapshot()] == ["ev0", "ev1", "ev2"]
+    assert rec.dropped == 0
+
+
+def test_span_records_duration_and_args():
+    rec = FlightRecorder(capacity=8)
+    with rec.span("work", height=7):
+        pass
+    (s,) = rec.snapshot()
+    assert s["name"] == "work"
+    assert s["ph"] == PH_SPAN
+    assert s["dur"] >= 0.0
+    assert s["args"] == {"height": 7}
+
+
+def test_span_recorded_on_exception_with_error_arg():
+    rec = FlightRecorder(capacity=8)
+    with pytest.raises(ValueError):
+        with rec.span("boom", height=1):
+            raise ValueError("x")
+    (s,) = rec.snapshot()
+    assert s["args"] == {"height": 1, "error": "ValueError"}
+
+
+def test_instant_and_last():
+    rec = FlightRecorder(capacity=8)
+    rec.instant("tick", n=1)
+    with rec.span("fixture"):
+        pass
+    rec.instant("tick", n=2)
+    assert rec.last("fixture")["name"] == "fixture"
+    assert rec.last("tick")["args"] == {"n": 2}
+    assert rec.last("missing") is None
+    assert rec.snapshot()[0]["ph"] == PH_INSTANT
+
+
+def test_clear_resets_ring():
+    rec = FlightRecorder(capacity=4)
+    for i in range(9):
+        rec.record(f"ev{i}", ts_s=0.0, dur_s=0.0)
+    rec.clear()
+    assert rec.snapshot() == []
+    assert rec.total == 0 and rec.dropped == 0
+
+
+def test_concurrent_records_all_counted():
+    rec = FlightRecorder(capacity=4096)
+
+    def worker(k):
+        for i in range(200):
+            rec.record(f"t{k}", ts_s=0.0, dur_s=0.0)
+
+    ts = [threading.Thread(target=worker, args=(k,)) for k in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert rec.total == 800
+    assert len(rec.snapshot()) == 800
+
+
+def test_chrome_trace_schema():
+    """The export must be loadable by Perfetto/chrome://tracing: X events
+    carry microsecond ts+dur, instants carry a scope, and every thread
+    gets an M thread_name metadata event."""
+    rec = FlightRecorder(capacity=16)
+    with rec.span("verify.dispatch", lanes=64):
+        pass
+    rec.instant("pool.evict", peer="ab")
+    doc = rec.to_chrome_trace()
+    assert doc["displayTimeUnit"] == "ms"
+    assert doc["otherData"]["recorder_total"] == 2
+    evs = doc["traceEvents"]
+    xs = [e for e in evs if e["ph"] == "X"]
+    ins = [e for e in evs if e["ph"] == "i"]
+    metas = [e for e in evs if e["ph"] == "M"]
+    assert len(xs) == 1 and len(ins) == 1 and len(metas) >= 1
+    x = xs[0]
+    assert x["name"] == "verify.dispatch"
+    assert {"pid", "tid", "ts", "dur"} <= set(x)
+    # ts is microseconds of a wall-clock anchor: must be a huge number,
+    # not raw seconds
+    assert x["ts"] > 1e12
+    assert x["args"] == {"lanes": 64}
+    assert ins[0]["s"] == "t"
+    assert metas[0]["name"] == "thread_name"
+    assert metas[0]["args"]["name"]
+    json.dumps(doc)                       # serializable end to end
+
+
+def test_dump_atomic_write(tmp_path):
+    rec = FlightRecorder(capacity=8)
+    with rec.span("a"):
+        pass
+    path = os.path.join(str(tmp_path), "sub", "trace.json")
+    assert rec.dump(path) == path
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["traceEvents"]
+    assert not os.path.exists(path + ".tmp")
+
+
+def test_capacity_validation():
+    with pytest.raises(ValueError):
+        FlightRecorder(capacity=0)
+
+
+def test_grown_timeout_zero_base_no_crash():
+    """Regression: `_grown` divided timeout_max by the base timeout; a
+    config with base 0 (skip a step instantly) crashed with
+    ZeroDivisionError the moment growth was enabled."""
+    from tendermint_tpu.config import ConsensusConfig
+    c = ConsensusConfig()
+    c.timeout_round_growth, c.timeout_max = 1.5, 8.0
+    c.timeout_propose, c.timeout_propose_delta = 0.0, 0.2
+    t = c.propose_timeout(10)
+    assert 0.0 < t <= c.timeout_max
