@@ -529,12 +529,15 @@ def native_scalar_rate(n: int = 1500) -> float:
 
 def config0_cpu_replay(quick: bool) -> dict:
     """4-validator kvstore chain replayed through the batched sync path
-    on the NATIVE CPU backend."""
-    from tendermint_tpu.crypto import backend as cb
+    on the NATIVE CPU backend (bigint python when the native library is
+    missing — slower, but the correctness replay still runs anywhere)."""
+    from tendermint_tpu.crypto import native
     n_blocks = 100 if quick else 1000
-    res = _replay_chain(n_vals=4, n_blocks=n_blocks, backend="native",
+    be = "native" if native.AVAILABLE else "python"
+    res = _replay_chain(n_vals=4, n_blocks=n_blocks, backend=be,
                         window=64)
     res["config"] = 0
+    res["backend"] = be
     return res
 
 
@@ -819,7 +822,7 @@ def _replay_chain(n_vals: int, n_blocks: int, backend: str,
     from concurrent.futures import ThreadPoolExecutor
     prep_pool = ThreadPoolExecutor(4)
 
-    def _prep(blocks):
+    def _prep(blocks, win=None):
         """Stage 1: part-set re-hash + lane assembly (host).  Hashing
         stays HOST-side here deliberately: the verify stage saturates the
         single device, so moving the part re-hash onto it (as tried with
@@ -827,8 +830,14 @@ def _replay_chain(n_vals: int, n_blocks: int, backend: str,
         end-to-end.  Lanes are the TEMPLATED form: ~1 message template
         per block plus per-lane (sig, validator index, template index) —
         the device assembles messages and gathers pubkeys itself, so the
-        host ships 72 B/lane instead of 228 B."""
-        with tracing.span("bench.prep", blocks=len(blocks)):
+        host ships 72 B/lane instead of 228 B.
+
+        `win` is the replay window index; it rides every stage's span as
+        the window= arg the attribution doctor groups by (the warm-up
+        window stays unkeyed so its compile cost isn't misattributed to
+        steady-state throughput)."""
+        wargs = {"window": win} if win is not None else {}
+        with tracing.span("bench.prep", blocks=len(blocks), **wargs):
             items, lanes = [], []
             # partial thread-level overlap: the hashlib/merkle C calls
             # inside make_part_set release the GIL (block encodes are
@@ -853,21 +862,25 @@ def _replay_chain(n_vals: int, n_blocks: int, backend: str,
                 # keeps telemetry and result trims keyed to real lanes
                 idxs, tmpl_idx, templates, sigs, n = prefetch(
                     idxs, tmpl_idx, templates, sigs)
-                return items, lanes, templates, tmpl_idx, sigs, idxs, n
-            return items, lanes, templates, tmpl_idx, sigs, idxs, len(idxs)
+                return win, items, lanes, templates, tmpl_idx, sigs, idxs, n
+            return (win, items, lanes, templates, tmpl_idx, sigs, idxs,
+                    len(idxs))
 
     def _dispatch(prepped):
         """Stage 2a: upload + queue the grouped device batch (async)."""
-        items, lanes, templates, tmpl_idx, sigs, idxs, n = prepped
-        with tracing.span("bench.dispatch", blocks=len(items), lanes=n):
+        win, items, lanes, templates, tmpl_idx, sigs, idxs, n = prepped
+        wargs = {"window": win} if win is not None else {}
+        with tracing.span("bench.dispatch", blocks=len(items), lanes=n,
+                          **wargs):
             fut = cb.verify_grouped_templated_async(
                 set_key, pubs_mat, idxs, tmpl_idx, templates, sigs,
                 real_n=n)
-        return items, lanes, fut
+        return win, items, lanes, fut
 
-    def _collect(items, lanes, fut):
+    def _collect(win, items, lanes, fut):
         """Stage 2b: block on the device result + per-commit tallies."""
-        with tracing.span("bench.verify", blocks=len(items)):
+        wargs = {"window": win} if win is not None else {}
+        with tracing.span("bench.verify", blocks=len(items), **wargs):
             ok = fut()
             off = 0
             for (bid, h, _, _), a in zip(items, lanes):
@@ -897,7 +910,7 @@ def _replay_chain(n_vals: int, n_blocks: int, backend: str,
         try:
             for i in range(0, len(chain), window):
                 t = time.perf_counter()
-                prepped = _prep(chain[i:i + window])
+                prepped = _prep(chain[i:i + window], win=i // window)
                 prep_seconds[0] += time.perf_counter() - t
                 prep_q.put(prepped)
             prep_q.put(None)
@@ -913,10 +926,10 @@ def _replay_chain(n_vals: int, n_blocks: int, backend: str,
 
         def drain_one():
             t = time.perf_counter()
-            items, lanes, fut = inflight.popleft()
-            _collect(items, lanes, fut)
+            win, items, lanes, fut = inflight.popleft()
+            _collect(win, items, lanes, fut)
             verify_seconds[0] += time.perf_counter() - t
-            verified_q.put(items)
+            verified_q.put((win, items))
 
         try:
             while True:
@@ -946,10 +959,11 @@ def _replay_chain(n_vals: int, n_blocks: int, backend: str,
             break
         if isinstance(got, BaseException):
             raise got
-        items = got
+        win, items = got
         total_sigs += sum(c.num_sigs() for _, _, c, _ in items)
         t = time.perf_counter()
-        with tracing.span("bench.apply", blocks=len(items)):
+        wargs = {"window": win} if win is not None else {}
+        with tracing.span("bench.apply", blocks=len(items), **wargs):
             for bid, h, c, parts in items:
                 block = chain[h - 1][0]
                 execution.apply_block(state, None, conns.consensus, block,
@@ -1199,6 +1213,22 @@ def main() -> None:
                                                  "0") or 0),
                     help="wall-clock budget in seconds; retries whose "
                          "fixture rebuild won't fit are skipped")
+    ap.add_argument("--doctor", action="store_true",
+                    help="emit the pipeline attribution report after the "
+                         "run (where did the wall clock go: compile / "
+                         "transfer / device-busy / scalar / idle)")
+    ap.add_argument("--doctor-out",
+                    default=os.environ.get("TM_BENCH_DOCTOR",
+                                           "bench_doctor.json"),
+                    help="attribution report JSON path (with --doctor)")
+    ap.add_argument("--ledger",
+                    default=os.environ.get("TM_BENCH_LEDGER",
+                                           "BENCH_LEDGER.jsonl"),
+                    help="bench regression ledger (JSONL, appended per "
+                         "run); empty string disables")
+    ap.add_argument("--regression-threshold", type=float, default=0.15,
+                    help="flag a config whose rate drops more than this "
+                         "fraction below the best prior ledger run")
     args = ap.parse_args()
 
     global BUDGET
@@ -1237,6 +1267,66 @@ def main() -> None:
             f"({tracing.RECORDER.total} spans)")
     except OSError as e:
         log(f"[bench] trace dump failed: {e}")
+
+    # attribution doctor + regression ledger (both best-effort: a
+    # reporting failure must not turn a finished bench into rc!=0)
+    report = regressions = None
+    try:
+        from tendermint_tpu.utils import attribution
+        report = attribution.doctor_report(tracing.RECORDER.snapshot())
+        for w in report["windows"]:
+            attribution.observe_window_metrics(w)
+    except Exception as e:
+        log(f"[bench] attribution failed: {e}")
+    if args.ledger:
+        try:
+            from tendermint_tpu.utils import ledger as ledger_mod
+            from tendermint_tpu.utils.metrics import REGISTRY
+            prior = ledger_mod.load(args.ledger)
+            config_results = {k: v for k, v in results.items()
+                              if k.startswith("config")
+                              and isinstance(v, dict) and "error" not in v}
+            regressions = ledger_mod.compute_deltas(
+                prior, config_results,
+                threshold=args.regression_threshold)
+            worst = min((r["delta_frac"] for r in regressions.values()
+                         if r["delta_frac"] is not None), default=0.0)
+            REGISTRY.bench_regression.set(worst)
+            entry = {
+                "schema": ledger_mod.LEDGER_SCHEMA,
+                "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                           time.gmtime()),
+                "quick": bool(args.quick),
+                "configs": config_results,
+                "headline": headline,
+                "deltas": regressions,
+                "attribution": report and report["headline_gap"],
+            }
+            ledger_mod.append_entry(args.ledger, entry)
+            log(f"[bench] ledger entry appended to {args.ledger} "
+                f"({len(prior) + 1} entries)")
+            flagged = [k for k, v in regressions.items()
+                       if v.get("regression")]
+            if flagged:
+                log(f"[bench] REGRESSION vs best prior run: "
+                    f"{', '.join(sorted(flagged))}")
+        except Exception as e:
+            log(f"[bench] ledger append failed: {e}")
+    if args.doctor and report is not None:
+        if regressions is not None:
+            report["regressions"] = regressions
+        try:
+            from tendermint_tpu.utils import attribution
+            tmp = args.doctor_out + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(report, f, indent=1)
+            os.replace(tmp, args.doctor_out)
+            log(f"[bench] doctor report written to {args.doctor_out}")
+            log("[doctor] " + attribution.render_report(report)
+                .replace("\n", "\n[doctor] "))
+        except Exception as e:
+            log(f"[bench] doctor report failed: {e}")
+
     log("[bench] detail: " + json.dumps(results, default=str))
     print(json.dumps(headline), flush=True)
 

@@ -342,6 +342,25 @@ class BlockchainReactor(Reactor):
                     log.info("valset changed mid-window; flushing",
                              height=b.height)
                     break
+        # the window-boundary span: covers verify (or lookahead reuse)
+        # through apply under one window=<first_height> key, which is
+        # what the attribution profiler groups by
+        tracing.RECORDER.record(
+            "fastsync.window", tracing.perf_to_epoch(t0),
+            time.perf_counter() - t0,
+            {"window": window[0].height, "blocks": applied})
+        try:
+            # per-window pipeline health -> Prometheus histograms; a
+            # failure here must never fail the sync itself
+            from tendermint_tpu.utils import attribution
+            spans = tracing.RECORDER.snapshot()
+            iv = attribution.find_windows(spans).get(window[0].height)
+            if iv is not None:
+                attribution.observe_window_metrics(
+                    attribution.attribute_interval(
+                        attribution.spans_by_category(spans), *iv))
+        except Exception:
+            pass
         log.debug("synced window", blocks=applied,
                   sigs=sum(len(i[2].precommits) for i in items),
                   verify_seconds=round(dt, 4),

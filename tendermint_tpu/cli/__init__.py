@@ -366,35 +366,118 @@ def cmd_wal_fsck(args) -> int:
     return 1
 
 
-def cmd_trace(args) -> int:
-    """Fetch a running node's flight recorder over RPC and write it as
-    Chrome trace-event JSON (open in Perfetto / chrome://tracing).
-    Requires the node to run with rpc.unsafe = true."""
+def _rpc_call(addr: str, method: str, params: dict, timeout: int = 30):
+    """One JSON-RPC call; returns the result dict or raises SystemExit
+    with a friendly message on an RPC-level error."""
     import urllib.request
-    url = args.rpc.rstrip("/")
+    url = addr.rstrip("/")
     if not url.startswith("http"):
         url = "http://" + url
-    body = json.dumps({"jsonrpc": "2.0", "id": 1,
-                       "method": "debug_flight_recorder",
-                       "params": {"format": "chrome"}}).encode()
+    body = json.dumps({"jsonrpc": "2.0", "id": 1, "method": method,
+                       "params": params}).encode()
     req = urllib.request.Request(
         url, data=body, headers={"Content-Type": "application/json"})
-    with urllib.request.urlopen(req, timeout=30) as resp:
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
         reply = json.loads(resp.read())
     if "error" in reply:
-        print(f"rpc error: {reply['error'].get('message')} "
-              "(is rpc.unsafe enabled on the node?)")
-        return 1
-    result = reply["result"]
+        raise SystemExit(f"rpc error: {reply['error'].get('message')} "
+                         "(is rpc.unsafe enabled on the node?)")
+    return reply["result"]
+
+
+def _filter_trace(trace: dict, last: int, name: str) -> dict:
+    """Apply --last/--name to a Chrome trace document: name filters by
+    substring, last keeps the N most recent span/instant events (ts
+    order); "M" metadata events always survive so thread names keep
+    resolving in the viewer."""
+    evs = trace.get("traceEvents", [])
+    meta = [e for e in evs if e.get("ph") == "M"]
+    spans = [e for e in evs if e.get("ph") != "M"]
+    if name:
+        spans = [e for e in spans if name in e.get("name", "")]
+    if last and last > 0:
+        spans = sorted(spans, key=lambda e: e.get("ts", 0))[-last:]
+    return {**trace, "traceEvents": spans + meta}
+
+
+def cmd_trace(args) -> int:
+    """Fetch a running node's flight recorder over RPC (or filter a
+    local dump with --in) and write it as Chrome trace-event JSON (open
+    in Perfetto / chrome://tracing).  --last/--name narrow a 100k-block
+    replay dump to the interesting tail without loading the full JSON.
+    RPC mode requires the node to run with rpc.unsafe = true."""
+    if args.infile:
+        with open(args.infile) as f:
+            trace = json.load(f)
+        total = dropped = None
+    else:
+        params = {"format": "chrome"}
+        if args.last:
+            params["last"] = args.last
+        if args.name:
+            params["name"] = args.name
+        result = _rpc_call(args.rpc, "debug_flight_recorder", params)
+        trace = result["trace"]
+        total, dropped = result["total"], result["dropped"]
+    # local filtering applies in both modes (an old node may ignore the
+    # RPC params; filtering again is idempotent)
+    trace = _filter_trace(trace, args.last, args.name)
+    spans = [e for e in trace["traceEvents"] if e.get("ph") != "M"]
+    if args.format == "lines":
+        for e in sorted(spans, key=lambda e: e.get("ts", 0)):
+            dur = e.get("dur", 0.0) / 1e3
+            cat = e.get("cat", "-")
+            print(f"{e.get('ts', 0) / 1e6:.6f} {dur:10.3f}ms "
+                  f"{cat:9s} {e.get('name', '')} "
+                  f"{json.dumps(e.get('args', {}))}")
+        return 0
     tmp = args.out + ".tmp"
     with open(tmp, "w") as f:
-        json.dump(result["trace"], f)
+        json.dump(trace, f)
     os.replace(tmp, args.out)
-    n = len(result["trace"]["traceEvents"])
-    print(f"wrote {n} trace events to {args.out} "
-          f"(recorder total={result['total']} "
-          f"dropped={result['dropped']})")
+    msg = f"wrote {len(spans)} trace events to {args.out}"
+    if total is not None:
+        msg += f" (recorder total={total} dropped={dropped})"
+    print(msg)
     return 0
+
+
+def cmd_doctor(args) -> int:
+    """Pipeline attribution report: where the wall clock of a replay
+    went (compile / transfer / device-busy / scalar / idle) and which
+    component is the largest thief of the throughput target.  Reads a
+    dumped trace file (--trace, e.g. bench_trace.json) or a live node's
+    flight recorder over unsafe RPC (--rpc)."""
+    from tendermint_tpu.utils import attribution, ledger as ledger_mod
+    if args.trace:
+        with open(args.trace) as f:
+            spans = attribution.spans_from_chrome(json.load(f))
+    else:
+        result = _rpc_call(args.rpc, "debug_flight_recorder",
+                           {"format": "chrome"})
+        spans = attribution.spans_from_chrome(result["trace"])
+    regressions = None
+    if args.ledger and os.path.exists(args.ledger):
+        entries = ledger_mod.load(args.ledger)
+        if entries:
+            regressions = ledger_mod.compute_deltas(
+                entries[:-1], entries[-1].get("configs") or {})
+    report = attribution.doctor_report(spans, regressions=regressions)
+    if args.json:
+        print(json.dumps(report, indent=1))
+    else:
+        print(attribution.render_report(report))
+    return 0
+
+
+def cmd_bench_history(args) -> int:
+    """Render the bench regression ledger: every recorded run's
+    per-config rates with deltas vs the best PRIOR run, so a slow creep
+    across runs reads as clearly as a cliff in one."""
+    from tendermint_tpu.utils import ledger as ledger_mod
+    entries = ledger_mod.load(args.ledger)
+    print(ledger_mod.render_history(entries))
+    return 1 if not entries else 0
 
 
 def cmd_version(args) -> int:
@@ -502,7 +585,41 @@ def main(argv=None) -> int:
                     help="node RPC address")
     sp.add_argument("--out", default="flight_trace.json",
                     help="output Chrome trace-event JSON path")
+    sp.add_argument("--in", dest="infile", default="",
+                    help="filter a local trace dump instead of RPC")
+    sp.add_argument("--last", type=int, default=0,
+                    help="keep only the N most recent spans")
+    sp.add_argument("--name", default="",
+                    help="keep only spans whose name contains SUBSTR")
+    sp.add_argument("--format", choices=("chrome", "lines"),
+                    default="chrome",
+                    help="chrome: write JSON to --out; lines: print "
+                         "one span per line to stdout")
     sp.set_defaults(fn=cmd_trace)
+
+    sp = sub.add_parser("doctor",
+                        help="pipeline attribution report: where the "
+                             "wall clock went, largest thief of the "
+                             "throughput target")
+    sp.add_argument("--trace", default="",
+                    help="read spans from a Chrome trace dump "
+                         "(e.g. bench_trace.json) instead of RPC")
+    sp.add_argument("--rpc", default="http://127.0.0.1:26657",
+                    help="node RPC address (used when --trace unset)")
+    sp.add_argument("--ledger", default="BENCH_LEDGER.jsonl",
+                    help="bench ledger to fold regression flags from "
+                         "('' to skip)")
+    sp.add_argument("--json", action="store_true",
+                    help="print the machine-readable report instead of "
+                         "the human summary")
+    sp.set_defaults(fn=cmd_doctor)
+
+    sp = sub.add_parser("bench-history",
+                        help="render the bench regression ledger with "
+                             "per-config deltas vs best prior run")
+    sp.add_argument("--ledger", default="BENCH_LEDGER.jsonl",
+                    help="ledger JSONL path (bench.py --ledger)")
+    sp.set_defaults(fn=cmd_bench_history)
 
     sp = sub.add_parser("version", help="print version")
     sp.set_defaults(fn=cmd_version)

@@ -17,15 +17,83 @@ from __future__ import annotations
 import os
 import threading
 import time
+from contextlib import contextmanager
 from typing import Protocol
 
 import numpy as np
 
 from tendermint_tpu.crypto import pure_ed25519 as _ref
-from tendermint_tpu.utils import tracing
+from tendermint_tpu.utils import metrics, tracing
 from tendermint_tpu.utils.metrics import REGISTRY
 
 MIN_BUCKET = 16
+
+# -- XLA compile/cache observability -----------------------------------------
+# jax's own jit cache is opaque, so we shadow it: per jit entry point,
+# the set of input (shape, dtype) signatures already dispatched.  A
+# signature seen before is a cache HIT; a new signature is a MISS (jit
+# will trace, and compile unless the persistent cache serves it); a new
+# signature on an entry that was already warm is shape DRIFT — the
+# _bucket() padding leaked a shape and the node just paid a silent
+# 100s-class recompile.  The monitoring listener in
+# _enable_compile_cache() counts the REAL backend compiles; the pair of
+# views separates "dispatched cold" from "actually compiled".
+_jit_shapes: dict[str, set] = {}
+_jit_lock = threading.Lock()
+
+
+def _note_dispatch(entry: str, *arrays) -> bool:
+    """Track `entry`'s seen input signatures; True when this dispatch is
+    COLD (first time this entry sees these shapes/dtypes)."""
+    sig = tuple((tuple(getattr(a, "shape", ())),
+                 str(getattr(a, "dtype", ""))) for a in arrays)
+    with _jit_lock:
+        seen = _jit_shapes.setdefault(entry, set())
+        if sig in seen:
+            hit = True
+        else:
+            hit = False
+            drift = bool(seen)
+            seen.add(sig)
+    if hit:
+        REGISTRY.xla_cache_hits.inc()
+        return False
+    REGISTRY.xla_cache_misses.inc()
+    if drift:
+        REGISTRY.xla_recompiles.inc()
+    return True
+
+
+@contextmanager
+def _firstcall(entry: str, cold: bool):
+    """Time a cold dispatch under an `xla.firstcall` span (category
+    `compile` for the attribution partition) — warm dispatches pass
+    through untimed."""
+    if not cold:
+        yield
+        return
+    t0 = time.perf_counter()
+    with tracing.span("xla.firstcall", entry=entry):
+        yield
+    REGISTRY.xla_first_call_seconds.observe(time.perf_counter() - t0)
+
+
+def _h2d(*arrays) -> None:
+    """Count host->device upload bytes for a dispatch (numpy inputs that
+    are about to become device arrays)."""
+    n = 0
+    for a in arrays:
+        nb = getattr(a, "nbytes", 0)
+        if nb:
+            n += int(nb)
+    if n:
+        REGISTRY.h2d_bytes.inc(n)
+
+
+def _d2h(out) -> None:
+    nb = getattr(out, "nbytes", 0)
+    if nb:
+        REGISTRY.d2h_bytes.inc(int(nb))
 
 
 class Backend(Protocol):
@@ -62,9 +130,13 @@ class PythonBackend:
 
     def verify_batch(self, pubkeys, msgs, sigs):
         out = np.zeros(len(pubkeys), dtype=bool)
-        for i in range(len(pubkeys)):
-            out[i] = _ref.verify(pubkeys[i].tobytes(), msgs[i].tobytes(),
-                                 sigs[i].tobytes())
+        # "scalar." prefix -> CAT_SCALAR: this is the scalar-tail time
+        # the attribution doctor reports when work falls off the device
+        with tracing.span("scalar.verify", lanes=len(pubkeys)):
+            for i in range(len(pubkeys)):
+                out[i] = _ref.verify(pubkeys[i].tobytes(),
+                                     msgs[i].tobytes(),
+                                     sigs[i].tobytes())
         REGISTRY.sigs_requested.inc(len(pubkeys))
         REGISTRY.sigs_verified.inc(int(out.sum()))
         return out
@@ -120,6 +192,8 @@ class TpuBackend:
         if n_dev > 1:
             from tendermint_tpu.parallel import sharding
             self._mesh = sharding.make_mesh(n_dev)
+        metrics.set_build_info(jax_backend=jax.default_backend(),
+                               local_devices=n_dev)
 
     def tables_cached(self, set_key: bytes) -> bool:
         """True when the comb tables for `set_key` are already resident —
@@ -139,12 +213,16 @@ class TpuBackend:
             msgs = np.concatenate([msgs, np.repeat(msgs[:1], pad, 0)])
             sigs = np.concatenate([sigs, np.repeat(sigs[:1], pad, 0)])
         jnp = self._jnp
+        _h2d(pubkeys, msgs, sigs)
+        cold = _note_dispatch("verify_batch", pubkeys, msgs, sigs)
         t0 = time.perf_counter()
-        with tracing.span("verify.batch", lanes=n, bucket=b):
+        with _firstcall("verify_batch", cold), \
+                tracing.span("verify.batch", lanes=n, bucket=b):
             out = self._dev.verify_batch(jnp.asarray(pubkeys),
                                          jnp.asarray(msgs),
                                          jnp.asarray(sigs))
             out = np.asarray(out)
+        _d2h(out)
         dt = time.perf_counter() - t0
         # sync call: dispatch and wait are one interval — record it under
         # both summaries so they stay comparable with the async path
@@ -342,10 +420,28 @@ class TpuBackend:
                                     "shape": list(shape),
                                     "cache_dir": cache_dir})
                 try:
-                    subprocess.run(
+                    proc = subprocess.run(
                         [_sys.executable, "-m",
                          "tendermint_tpu.crypto.warmcompile", spec],
                         capture_output=True, timeout=600)
+                    # the warmer reports its compile time as a JSON line
+                    # (the compile happened in ANOTHER process, so the
+                    # in-process monitoring listener never saw it)
+                    for line in reversed(
+                            (proc.stdout or b"").decode(
+                                errors="replace").splitlines()):
+                        line = line.strip()
+                        if not line.startswith("{"):
+                            continue
+                        info = _json.loads(line)
+                        secs = float(info.get("compile_seconds") or 0.0)
+                        if secs > 0:
+                            REGISTRY.xla_compiles.inc()
+                            REGISTRY.xla_compile_seconds.observe(secs)
+                            tracing.RECORDER.record(
+                                "xla.compile", time.time() - secs, secs,
+                                {"entry": "warmcompile", "kind": kind})
+                        break
                 except Exception:
                     pass
                 # phase 2: dummy call through THIS process's jit cache —
@@ -412,8 +508,12 @@ class TpuBackend:
             templates = np.concatenate(
                 [templates,
                  np.zeros((tb - t, templates.shape[1]), np.uint8)])
-        return (jax.device_put(val_idx), jax.device_put(tmpl_idx),
-                jax.device_put(templates), jax.device_put(sigs), n)
+        _h2d(val_idx, tmpl_idx, templates, sigs)
+        with tracing.span("transfer.h2d", lanes=n,
+                          bytes=int(val_idx.nbytes + tmpl_idx.nbytes +
+                                    templates.nbytes + sigs.nbytes)):
+            return (jax.device_put(val_idx), jax.device_put(tmpl_idx),
+                    jax.device_put(templates), jax.device_put(sigs), n)
 
     def verify_grouped_templated_async(self, set_key, val_pubs, val_idx,
                                        tmpl_idx, templates, sigs,
@@ -463,8 +563,13 @@ class TpuBackend:
                 [templates, np.zeros((tb - t, templates.shape[1]),
                                      np.uint8)])
         jnp = self._jnp
+        if real_n is None:       # prefetched inputs were counted at put
+            _h2d(val_idx, tmpl_idx, templates, sigs)
+        cold = _note_dispatch("verify_grouped_templated", tbl, val_idx,
+                              tmpl_idx, templates, sigs)
         t0 = time.perf_counter()
-        with tracing.span("verify.dispatch", lanes=n, bucket=b):
+        with _firstcall("verify_grouped_templated", cold), \
+                tracing.span("verify.dispatch", lanes=n, bucket=b):
             dev_out = self._dev.verify_grouped_templated_jit(
                 tbl, pub_ok, vp_dev, jnp.asarray(val_idx.astype(np.int32)),
                 jnp.asarray(tmpl_idx.astype(np.int32)),
@@ -479,6 +584,7 @@ class TpuBackend:
             t1 = time.perf_counter()
             with tracing.span("verify.collect", lanes=n, bucket=b):
                 out = np.asarray(dev_out)
+            _d2h(out)
             now = time.perf_counter()
             REGISTRY.device_step_seconds.observe(now - t1)
             REGISTRY.device_dispatch_seconds.observe(now - t0)
@@ -542,11 +648,16 @@ class TpuBackend:
                 [templates,
                  np.zeros((tb - t, templates.shape[1]), np.uint8)])
         jnp = self._jnp
-        with tracing.span("sign.batch", lanes=n, bucket=b):
+        _h2d(val_idx, tmpl_idx, templates)
+        cold = _note_dispatch("sign_grouped_templated", a_dev, val_idx,
+                              tmpl_idx, templates)
+        with _firstcall("sign_grouped_templated", cold), \
+                tracing.span("sign.batch", lanes=n, bucket=b):
             out = np.asarray(self._dev.sign_grouped_templated_jit(
                 a_dev, pre_dev, pubs_dev, jnp.asarray(val_idx),
                 jnp.asarray(tmpl_idx), jnp.asarray(templates),
                 self._base_tbl))
+        _d2h(out)
         return out[:n]
 
     def precompile_for_validators(self, vals) -> None:
@@ -638,9 +749,15 @@ class TpuBackend:
             msgs = np.concatenate([msgs, np.repeat(msgs[:1], pad, 0)])
             sigs = np.concatenate([sigs, np.repeat(sigs[:1], pad, 0)])
         jnp = self._jnp
+        _h2d(val_idx, pubkeys, msgs, sigs)
+        on_mesh = self._mesh_eligible(b)
+        cold = _note_dispatch(
+            "verify_grouped_sharded" if on_mesh else "verify_grouped",
+            tbl, val_idx, pubkeys, msgs, sigs)
         t0 = time.perf_counter()
-        with tracing.span("verify.grouped", lanes=n, bucket=b):
-            if self._mesh_eligible(b):
+        with _firstcall("verify_grouped", cold), \
+                tracing.span("verify.grouped", lanes=n, bucket=b):
+            if on_mesh:
                 fn = self._sharded_fn(tbl.shape[2], msgs.shape[-1])
                 out = fn(tbl, pub_ok, val_idx.astype(np.int32), pubkeys,
                          msgs, sigs)
@@ -650,7 +767,11 @@ class TpuBackend:
                     jnp.asarray(pubkeys), jnp.asarray(msgs),
                     jnp.asarray(sigs), self._base_tbl)
             out = np.asarray(out)
+        _d2h(out)
         dt = time.perf_counter() - t0
+        if on_mesh:
+            from tendermint_tpu.parallel import sharding
+            sharding.note_sharded_call(self._mesh, dt, n)
         REGISTRY.device_step_seconds.observe(dt)      # sync: step ==
         REGISTRY.device_dispatch_seconds.observe(dt)  # dispatch interval
         REGISTRY.device_step_hist.observe(dt)
@@ -684,6 +805,25 @@ def _enable_compile_cache() -> None:
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception:
         pass  # cache is an optimization; never block startup on it
+    try:
+        # count REAL backend compiles (the monitoring event fires only
+        # when XLA actually compiles — persistent-cache loads and jit
+        # cache hits stay silent), and drop a retroactive span into the
+        # flight recorder so the doctor attributes the interval to
+        # `compile` rather than device-idle
+        from jax import monitoring as _monitoring
+
+        def _on_compile(event: str, duration: float, **kw) -> None:
+            if "backend_compile" not in event:
+                return
+            REGISTRY.xla_compiles.inc()
+            REGISTRY.xla_compile_seconds.observe(duration)
+            tracing.RECORDER.record("xla.compile", time.time() - duration,
+                                    duration, {"event": event})
+
+        _monitoring.register_event_duration_secs_listener(_on_compile)
+    except Exception:
+        pass  # observability must never block startup either
 
 
 def _native_backend():
@@ -721,6 +861,7 @@ def set_backend(name: str) -> Backend:
                          f"known: {sorted(_BACKENDS)}")
     with _lock:
         _current = _BACKENDS[name]()
+    metrics.set_build_info(crypto_backend=name)
     return _current
 
 
@@ -733,6 +874,7 @@ def set_backend_supervised(primary: str = "tpu", **knobs) -> Backend:
     from tendermint_tpu.crypto.supervised import SupervisedBackend
     with _lock:
         _current = SupervisedBackend.build(primary, **knobs)
+    metrics.set_build_info(crypto_backend=f"supervised:{primary}")
     return _current
 
 
@@ -753,6 +895,7 @@ def get_backend() -> Backend:
                     f"crypto backend {name!r} unavailable ({e}); "
                     f"falling back to the slow python backend")
                 _current = PythonBackend()
+            metrics.set_build_info(crypto_backend=_current.name)
     return _current
 
 

@@ -10,6 +10,10 @@ seconds.
 Usage: python -m tendermint_tpu.crypto.warmcompile '<json-spec>'
 spec: {"kind": "templated"|"plain", "vb": int, "shape": [..],
        "cache_dir": str}
+
+The last stdout line is a JSON report ({"kind", "compile_seconds"}) the
+parent parses into its XLA compile metrics — the compile happens in THIS
+process, so the parent's jax.monitoring listener never sees it.
 """
 
 from __future__ import annotations
@@ -17,11 +21,13 @@ from __future__ import annotations
 import json
 import os
 import sys
+import time
 
 
 def main() -> int:
     spec = json.loads(sys.argv[1])
     os.environ["TM_JAX_CACHE_DIR"] = spec["cache_dir"]
+    t0 = time.perf_counter()
     import jax.numpy as jnp
     from tendermint_tpu.crypto.backend import _enable_compile_cache
     from tendermint_tpu.ops import ed25519 as dev
@@ -47,6 +53,11 @@ def main() -> int:
             jnp.zeros((b, mlen), jnp.uint8),
             jnp.zeros((b, 64), jnp.uint8), base_tbl)
     out.block_until_ready()
+    # includes jax import + trace + compile: the parent treats the whole
+    # interval as compile-plane time (that is what the warmer displaced)
+    print(json.dumps({"kind": spec["kind"],
+                      "compile_seconds": round(time.perf_counter() - t0,
+                                               3)}))
     return 0
 
 

@@ -15,6 +15,9 @@ driver's dry-run exercise multi-chip paths without hardware).
 
 from __future__ import annotations
 
+import threading
+import time
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -22,6 +25,43 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tendermint_tpu.ops import ed25519 as _ed
 from tendermint_tpu.ops import merkle as _merkle
+
+# -- per-device utilization bookkeeping --------------------------------------
+# A 1-D mesh splits lanes evenly, so one sharded call marks every mesh
+# device busy for the call's duration; utilization is accumulated busy
+# time over elapsed time since the first sharded call.  Device-LEVEL
+# imbalance (one slow chip) shows up in an XPlane capture, not here —
+# this answers the cheaper always-on question "are the extra chips
+# earning their keep at all".
+_usage_lock = threading.Lock()
+_usage_busy: dict[str, float] = {}
+_usage_t0: float | None = None
+
+
+def device_label(d) -> str:
+    return f"{getattr(d, 'platform', 'dev')}:{getattr(d, 'id', 0)}"
+
+
+def note_sharded_call(mesh: Mesh, dur_s: float, lanes: int) -> None:
+    """Fold one sharded verify call into the per-device utilization
+    gauges (`tendermint_device_util{device=...}`) and lane counters."""
+    from tendermint_tpu.utils.metrics import REGISTRY
+    global _usage_t0
+    devs = list(mesh.devices.flat)
+    if not devs:
+        return
+    per_dev = lanes // len(devs)
+    now = time.perf_counter()
+    with _usage_lock:
+        if _usage_t0 is None:
+            _usage_t0 = now - max(dur_s, 1e-9)
+        elapsed = max(now - _usage_t0, 1e-9)
+        for d in devs:
+            label = device_label(d)
+            _usage_busy[label] = _usage_busy.get(label, 0.0) + dur_s
+            REGISTRY.device_util.labels(label).set(
+                min(1.0, _usage_busy[label] / elapsed))
+            REGISTRY.device_lanes.labels(label).inc(per_dev)
 
 
 def make_mesh(n_devices: int | None = None, axis: str = "batch",

@@ -92,6 +92,8 @@ class Routes:
                 "debug_trace_start": self.debug_trace_start,
                 "debug_trace_stop": self.debug_trace_stop,
                 "debug_flight_recorder": self.debug_flight_recorder,
+                "debug_doctor": self.debug_doctor,
+                "debug_bench_history": self.debug_bench_history,
             })
 
     # -- info routes ----------------------------------------------------
@@ -253,14 +255,34 @@ class Routes:
         """Dump the in-process flight recorder.  format="chrome" returns
         the Chrome trace-event JSON (load in Perfetto / chrome://tracing);
         the default "spans" form is the raw oldest-first span list.
-        clear=true empties the ring after the dump."""
+        name=SUBSTR keeps only matching spans, last=N the N most recent
+        (filters apply server-side so a 16k-span ring doesn't cross the
+        wire to answer a question about its tail).  clear=true empties
+        the ring after the dump."""
         from tendermint_tpu.utils import tracing
         rec = tracing.RECORDER
         fmt = str(params.get("format", "spans"))
+        name = str(params.get("name", "") or "")
+        last = int(params.get("last", 0) or 0)
+
+        def _filter(evs, ts_key="ts"):
+            if name:
+                evs = [e for e in evs if name in e.get("name", "")]
+            if last > 0:
+                evs = sorted(evs, key=lambda e: e.get(ts_key, 0))[-last:]
+            return evs
+
         if fmt == "chrome":
-            out = {"trace": rec.to_chrome_trace()}
+            trace = rec.to_chrome_trace()
+            if name or last:
+                meta = [e for e in trace["traceEvents"]
+                        if e.get("ph") == "M"]
+                spans = [e for e in trace["traceEvents"]
+                         if e.get("ph") != "M"]
+                trace["traceEvents"] = _filter(spans) + meta
+            out = {"trace": trace}
         elif fmt == "spans":
-            out = {"spans": rec.snapshot()}
+            out = {"spans": _filter(rec.snapshot())}
         else:
             raise ValueError("format must be 'spans' or 'chrome'")
         out.update({"total": rec.total, "dropped": rec.dropped,
@@ -268,6 +290,38 @@ class Routes:
         if str(params.get("clear", "")).lower() in ("1", "true", "yes"):
             rec.clear()
         return out
+
+    def debug_doctor(self, params: dict) -> dict:
+        """Pipeline attribution over the live flight recorder: per-window
+        wall-clock partition (compile / transfer / device / scalar /
+        idle) and the largest thief of the throughput target."""
+        from tendermint_tpu.utils import attribution, tracing
+        return {"report": attribution.doctor_report(
+            tracing.RECORDER.snapshot())}
+
+    def debug_bench_history(self, params: dict) -> dict:
+        """Bench regression ledger entries with deltas vs best prior
+        run.  The ledger path is an RPC param: restricted to a flat
+        filename in the node's working directory (same containment rule
+        as debug_trace_start — no path escape)."""
+        import os
+        import re
+        from tendermint_tpu.utils import ledger
+        name = str(params.get("ledger") or ledger.DEFAULT_PATH)
+        if (not re.fullmatch(r"[A-Za-z0-9._-]{1,64}", name)
+                or set(name) == {"."}):
+            raise ValueError("ledger must match [A-Za-z0-9._-]{1,64}")
+        base = os.path.realpath(os.getcwd())
+        path = os.path.realpath(os.path.join(base, name))
+        if os.path.dirname(path) != base:
+            raise ValueError("ledger path escapes the working directory")
+        entries = ledger.load(path)
+        deltas = None
+        if entries:
+            deltas = ledger.compute_deltas(
+                entries[:-1], entries[-1].get("configs") or {})
+        return {"entries": entries, "count": len(entries),
+                "latest_deltas": deltas}
 
     def net_info(self, params: dict) -> dict:
         sw = self.node.switch

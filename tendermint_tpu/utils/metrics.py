@@ -203,6 +203,30 @@ class CounterVec:
             return [(k, c.value) for k, c in sorted(self._cells.items())]
 
 
+class GaugeVec:
+    """A gauge family keyed by one label — per-device utilization gauges
+    (`vec.labels("tpu:0").set(0.92)`) without pre-declaring the device
+    list."""
+
+    __slots__ = ("label", "_cells", "_lock")
+
+    def __init__(self, label: str):
+        self.label = label
+        self._cells: dict[str, Gauge] = {}
+        self._lock = threading.Lock()
+
+    def labels(self, value: str) -> Gauge:
+        with self._lock:
+            g = self._cells.get(value)
+            if g is None:
+                g = self._cells[value] = Gauge()
+            return g
+
+    def items(self) -> list[tuple[str, float]]:
+        with self._lock:
+            return [(k, g.value) for k, g in sorted(self._cells.items())]
+
+
 class Registry:
     def __init__(self):
         self._start = time.time()
@@ -245,6 +269,34 @@ class Registry:
         self.peers = Gauge()
         self.msgs_sent = Counter()
         self.msgs_received = Counter()
+        # XLA compile/cache plane (crypto/backend.py instrumentation):
+        # first-call compiles are the 100-160s tax the warm cache exists
+        # to kill; a recompile on a warm entry means SHAPE DRIFT — the
+        # bucketing in crypto/backend._bucket() leaked a new padded shape
+        self.xla_compiles = Counter()           # real backend compiles
+        self.xla_compile_seconds = Summary()    # per-compile duration
+        self.xla_first_call_seconds = Summary()  # first dispatch per entry
+        self.xla_cache_hits = Counter()         # dispatch on a warm shape
+        self.xla_cache_misses = Counter()       # dispatch on a cold shape
+        self.xla_recompiles = Counter()         # new shape on a warm entry
+        # host<->device transfer plane
+        self.h2d_bytes = Counter()
+        self.d2h_bytes = Counter()
+        # per-device plane (parallel/sharding.py multi-device runs)
+        self.device_util = GaugeVec("device")    # busy fraction per device
+        self.device_lanes = CounterVec("device")  # lanes served per device
+        # pipeline attribution plane (utils/attribution.py per-window
+        # partition of replay wall clock)
+        self.window_overlap_frac_hist = Histogram(Histogram.RATIO_BOUNDS)
+        self.window_device_busy_frac_hist = Histogram(
+            Histogram.RATIO_BOUNDS)
+        self.window_device_idle_frac_hist = Histogram(
+            Histogram.RATIO_BOUNDS)
+        self.window_scalar_seconds = Histogram(Histogram.DURATION_BOUNDS)
+        # bench regression ledger (utils/ledger.py): worst per-config
+        # delta_frac of the latest run vs best prior (negative = slower);
+        # alert on < -threshold
+        self.bench_regression = Gauge()
 
     def snapshot(self) -> dict:
         up = max(time.time() - self._start, 1e-9)
@@ -282,6 +334,16 @@ class Registry:
             "round_seconds": self.round_seconds_hist.snapshot(),
             "crypto_rung_calls": dict(self.crypto_rung_calls.items()),
             "crypto_rung_faults": dict(self.crypto_rung_faults.items()),
+            "xla_compiles": self.xla_compiles.value,
+            "xla_compile_seconds_mean":
+                round(self.xla_compile_seconds.mean, 3),
+            "xla_cache_hits": self.xla_cache_hits.value,
+            "xla_cache_misses": self.xla_cache_misses.value,
+            "xla_recompiles": self.xla_recompiles.value,
+            "h2d_bytes": self.h2d_bytes.value,
+            "d2h_bytes": self.d2h_bytes.value,
+            "device_util": dict(self.device_util.items()),
+            "bench_regression": self.bench_regression.value,
         }
 
 
@@ -296,12 +358,48 @@ def snapshot() -> dict:
 
 _PROM_PREFIX = "tendermint_"
 
+# wall-clock process start, exported as the standard (unprefixed)
+# `process_start_time_seconds` so Prometheus' `time() - ...` uptime
+# recipes and restart detection work against this exporter
+_PROCESS_START = time.time()
+
+# build_info labels, populated by set_build_info() as subsystems learn
+# facts about themselves (crypto backend init fills in the jax backend
+# and device count); rendered as the conventional value-1 info gauge
+_BUILD_INFO: dict[str, str] = {}
+_BUILD_INFO_LOCK = threading.Lock()
+
+
+def set_build_info(**labels) -> None:
+    """Merge label->value pairs into the build_info gauge (values are
+    stringified; None values are skipped)."""
+    with _BUILD_INFO_LOCK:
+        for k, v in labels.items():
+            if v is not None:
+                _BUILD_INFO[k] = str(v)
+
+
+try:
+    from tendermint_tpu import __version__ as _VERSION
+except Exception:                                    # pragma: no cover
+    _VERSION = "unknown"
+set_build_info(version=_VERSION)
+
 
 def _prom_f(v: float) -> str:
     """Prometheus float rendering: +Inf spelled out, no exponent noise."""
     if v == float("inf"):
         return "+Inf"
     return repr(float(v)) if isinstance(v, float) else str(v)
+
+
+def _prom_escape(v: str) -> str:
+    """Label-VALUE escaping per the 0.0.4 text format: backslash, double
+    quote and line feed must be escaped inside the quotes — an unescaped
+    newline in a label value splits the line and corrupts the whole
+    scrape."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
 
 
 def prometheus_text(registry: Registry | None = None) -> str:
@@ -335,8 +433,25 @@ def prometheus_text(registry: Registry | None = None) -> str:
             lines.append(f"# TYPE {name} counter")
             for label_value, v in inst.items():
                 lines.append(
-                    f"{name}{{{inst.label}=\"{label_value}\"}} {v}")
+                    f"{name}{{{inst.label}=\"{_prom_escape(label_value)}\"}}"
+                    f" {v}")
+        elif isinstance(inst, GaugeVec):
+            lines.append(f"# TYPE {name} gauge")
+            for label_value, v in inst.items():
+                lines.append(
+                    f"{name}{{{inst.label}=\"{_prom_escape(label_value)}\"}}"
+                    f" {_prom_f(v)}")
     lines.append(f"# TYPE {_PROM_PREFIX}uptime_seconds gauge")
     lines.append(f"{_PROM_PREFIX}uptime_seconds "
                  f"{_prom_f(round(time.time() - r._start, 3))}")
+    # standard process metric (unprefixed by convention): lets the usual
+    # restart-detection and uptime recording rules work unmodified
+    lines.append("# TYPE process_start_time_seconds gauge")
+    lines.append(f"process_start_time_seconds {_prom_f(_PROCESS_START)}")
+    with _BUILD_INFO_LOCK:
+        info = dict(_BUILD_INFO)
+    labels = ",".join(f'{k}="{_prom_escape(v)}"'
+                      for k, v in sorted(info.items()))
+    lines.append(f"# TYPE {_PROM_PREFIX}build_info gauge")
+    lines.append(f"{_PROM_PREFIX}build_info{{{labels}}} 1")
     return "\n".join(lines) + "\n"

@@ -44,12 +44,61 @@ PH_SPAN = "X"        # Chrome "complete" event (ts + dur)
 PH_INSTANT = "i"     # Chrome "instant" event
 
 
+def perf_to_epoch(p: float) -> float:
+    """Map a time.perf_counter() reading onto the recorder's wall-clock
+    axis — for callers recording a span from timestamps they already
+    took (e.g. reactor window accounting) instead of via span()."""
+    return _EPOCH_T0 + p
+
+# -- span categories ---------------------------------------------------------
+# Every span carries a category so the attribution profiler
+# (utils/attribution.py) can partition a replay window's wall clock into
+# compile / transfer / device-busy / scalar / idle without knowing every
+# span name.  Call sites may pass cat= explicitly; otherwise the name
+# prefix decides (longest prefix wins).
+CAT_PREP = "prep"          # host-side window assembly (hashing, lanes)
+CAT_DISPATCH = "dispatch"  # host-side device enqueue (async upload+queue)
+CAT_DEVICE = "device"      # wait-for-device-result / sync device calls
+CAT_APPLY = "apply"        # host-side ABCI/store application
+CAT_COMPILE = "compile"    # XLA compile / first-call executables
+CAT_TRANSFER = "transfer"  # host<->device copies
+CAT_SCALAR = "scalar"      # scalar/python fallback crypto
+
+_CAT_BY_PREFIX = (
+    ("xla.", CAT_COMPILE),
+    ("transfer.", CAT_TRANSFER),
+    ("scalar.", CAT_SCALAR),
+    ("verify.dispatch", CAT_DISPATCH),
+    ("verify.collect", CAT_DEVICE),
+    ("verify.batch", CAT_DEVICE),
+    ("verify.grouped", CAT_DEVICE),
+    ("sign.batch", CAT_DEVICE),
+    ("bench.prep", CAT_PREP),
+    ("bench.dispatch", CAT_DISPATCH),
+    ("bench.apply", CAT_APPLY),
+    ("fastsync.prepare", CAT_PREP),
+    ("fastsync.lookahead", CAT_PREP),
+    ("fastsync.apply", CAT_APPLY),
+)
+
+
+def default_category(name: str) -> str | None:
+    """Category inferred from a span name, or None when no rule matches
+    (uncategorized spans simply don't participate in attribution)."""
+    for prefix, cat in _CAT_BY_PREFIX:
+        if name.startswith(prefix):
+            return cat
+    return None
+
+
 class FlightRecorder:
     """Fixed-capacity ring of span records, oldest overwritten first.
 
-    A record is the tuple (name, ph, ts_s, dur_s, tid, tname, args):
-    wall-clock start, monotonic duration, originating thread.  Tuples
-    (not dicts) keep the hot-path allocation to one object."""
+    A record is the tuple (name, ph, ts_s, dur_s, tid, tname, cat, lane,
+    args): wall-clock start, monotonic duration, originating thread,
+    attribution category, and lane (the logical thread/stream the work
+    ran on — defaults to the recording thread's name).  Tuples (not
+    dicts) keep the hot-path allocation to one object."""
 
     def __init__(self, capacity: int = 16384):
         if capacity < 1:
@@ -62,19 +111,26 @@ class FlightRecorder:
 
     # -- recording -------------------------------------------------------
     def record(self, name: str, ts_s: float, dur_s: float,
-               args: dict | None = None, ph: str = PH_SPAN) -> None:
+               args: dict | None = None, ph: str = PH_SPAN,
+               cat: str | None = None, lane: str | None = None) -> None:
         t = threading.current_thread()
-        rec = (name, ph, ts_s, dur_s, t.ident or 0, t.name, args or None)
+        if cat is None:
+            cat = default_category(name)
+        rec = (name, ph, ts_s, dur_s, t.ident or 0, t.name, cat,
+               lane or t.name, args or None)
         with self._lock:
             self._buf[self._head] = rec
             self._head = (self._head + 1) % self.capacity
             self._total += 1
 
     @contextmanager
-    def span(self, name: str, **args):
+    def span(self, name: str, cat: str | None = None,
+             lane: str | None = None, **args):
         """Time a block; the span is recorded even when the block raises
         (a span that vanishes on failure hides exactly the interesting
-        case), with error=<type> appended to its args."""
+        case), with error=<type> appended to its args.  `cat` and `lane`
+        are reserved keywords feeding the attribution profiler; every
+        other keyword lands in the span's args."""
         p0 = time.perf_counter()
         try:
             yield
@@ -83,7 +139,7 @@ class FlightRecorder:
             raise
         finally:
             self.record(name, _EPOCH_T0 + p0, time.perf_counter() - p0,
-                        args)
+                        args, cat=cat, lane=lane)
 
     def instant(self, name: str, **args) -> None:
         self.record(name, _EPOCH_T0 + time.perf_counter(), 0.0, args,
@@ -98,10 +154,12 @@ class FlightRecorder:
             else:
                 recs = self._buf[:self._head]
         return [{"name": n, "ph": ph, "ts": ts, "dur": dur,
-                 "tid": tid, "thread": tname,
+                 "tid": tid, "thread": tname, "lane": lane,
+                 **({"cat": cat} if cat else {}),
                  **({"args": args} if args else {})}
                 for rec in recs if rec is not None
-                for (n, ph, ts, dur, tid, tname, args) in (rec,)]
+                for (n, ph, ts, dur, tid, tname, cat, lane, args)
+                in (rec,)]
 
     def last(self, name: str) -> dict | None:
         """Most recent span with `name` (bench's budget manager reads the
@@ -139,6 +197,8 @@ class FlightRecorder:
             threads.setdefault(tid, rec["thread"])
             ev = {"name": rec["name"], "ph": rec["ph"], "pid": pid,
                   "tid": tid, "ts": rec["ts"] * 1e6}
+            if "cat" in rec:
+                ev["cat"] = rec["cat"]
             if rec["ph"] == PH_SPAN:
                 ev["dur"] = rec["dur"] * 1e6
             else:

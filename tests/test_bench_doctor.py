@@ -1,0 +1,82 @@
+"""End-to-end doctor smoke test: a tiny CPU-only bench replay run with
+--doctor must produce (a) an attribution report whose partition
+components sum within 10% of each window's wall clock and (b) a ledger
+entry with per-config rates — and a second run against the same ledger
+must carry deltas vs the first.  This is the acceptance gate for the
+attribution profiler; it runs the real bench.py subprocess under
+JAX_PLATFORMS=cpu so it never needs a device."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_PARTITION = ("compile", "transfer", "device_busy", "scalar_tail",
+              "device_idle")
+
+
+def _run_bench(tmp_path, tag):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    out = subprocess.run(
+        [sys.executable, "bench.py", "--quick", "--config", "0",
+         "--doctor",
+         "--doctor-out", str(tmp_path / f"doctor{tag}.json"),
+         "--ledger", str(tmp_path / "ledger.jsonl"),
+         "--partial-out", str(tmp_path / f"partial{tag}.json"),
+         "--trace-out", str(tmp_path / f"trace{tag}.json")],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=180)
+    assert out.returncode == 0, out.stderr[-2000:]
+    # the protocol: last stdout line is the single headline JSON
+    headline = json.loads(out.stdout.strip().splitlines()[-1])
+    assert "metric" in headline
+    with open(tmp_path / f"doctor{tag}.json") as f:
+        report = json.load(f)
+    return out, report
+
+
+def test_bench_doctor_report_and_ledger(tmp_path):
+    out, report = _run_bench(tmp_path, "1")
+
+    # -- doctor report schema + partition invariant ----------------------
+    assert report["schema"] == "tpu-bft-doctor/1"
+    assert report["window_count"] >= 1
+    assert report["largest_thief"] in _PARTITION
+    for w in report["windows"]:
+        parts = sum(w[k] for k in _PARTITION)
+        assert abs(parts - w["wall"]) <= 0.1 * w["wall"] + 1e-6, w
+    gap = report["headline_gap"]
+    assert abs(sum(gap[k] for k in _PARTITION) - gap["wall"]) \
+        <= 0.1 * gap["wall"] + 1e-6
+    # the human summary rode along on stderr (stdout stays protocol-clean:
+    # its last line is the headline JSON)
+    assert "[doctor]" in out.stderr
+    assert "largest thief" in out.stderr
+
+    # -- ledger entry ----------------------------------------------------
+    with open(tmp_path / "ledger.jsonl") as f:
+        entries = [json.loads(ln) for ln in f if ln.strip()]
+    assert len(entries) == 1
+    e = entries[0]
+    assert e["schema"] == "tpu-bft-bench-ledger/1"
+    assert "config0" in e["configs"]
+    rate_key = ("blocks_per_sec"
+                if "blocks_per_sec" in e["configs"]["config0"]
+                else "sigs_per_sec")
+    assert e["configs"]["config0"][rate_key] > 0
+    assert e["deltas"]["config0"]["best_prior"] is None   # first run
+    assert e["attribution"]["wall"] > 0
+
+    # -- second run: deltas vs the first ---------------------------------
+    _, report2 = _run_bench(tmp_path, "2")
+    with open(tmp_path / "ledger.jsonl") as f:
+        entries = [json.loads(ln) for ln in f if ln.strip()]
+    assert len(entries) == 2
+    d = entries[1]["deltas"]["config0"]
+    assert d["best_prior"] is not None
+    assert d["delta_frac"] is not None
+    assert isinstance(d["regression"], bool)
+    # regressions (if any) are folded into the doctor report
+    assert report2.get("regressions", {}).get("config0", {}) \
+        .get("best_prior") is not None

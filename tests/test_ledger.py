@@ -1,0 +1,106 @@
+"""Bench regression ledger tests (utils/ledger.py): append/load round
+trip, corrupt-line tolerance, best-prior tracking, delta computation with
+the regression flag, and the history rendering."""
+
+import json
+import os
+
+from tendermint_tpu.utils import ledger
+
+
+def _entry(ts, **rates):
+    return {"schema": ledger.LEDGER_SCHEMA, "timestamp": ts,
+            "quick": True,
+            "configs": {cfg: {ledger.RATE_KEYS[cfg]: r}
+                        for cfg, r in rates.items()}}
+
+
+def test_append_load_round_trip(tmp_path):
+    path = os.path.join(str(tmp_path), "sub", "ledger.jsonl")
+    e1 = _entry("2026-01-01T00:00:00Z", config0=50.0)
+    e2 = _entry("2026-01-02T00:00:00Z", config0=60.0, config1=1e6)
+    ledger.append_entry(path, e1)
+    ledger.append_entry(path, e2)
+    got = ledger.load(path)
+    assert got == [e1, e2]
+    with open(path) as f:
+        assert len(f.read().strip().splitlines()) == 2
+
+
+def test_load_skips_corrupt_lines_and_missing_file(tmp_path):
+    path = str(tmp_path / "ledger.jsonl")
+    ledger.append_entry(path, _entry("t1", config0=1.0))
+    with open(path, "a") as f:
+        f.write('{"truncated": \n')           # torn write
+        f.write("not json at all\n")
+        f.write("[1, 2, 3]\n")                # valid JSON, not an object
+    ledger.append_entry(path, _entry("t2", config0=2.0))
+    got = ledger.load(path)
+    assert [e["timestamp"] for e in got] == ["t1", "t2"]
+    assert ledger.load(str(tmp_path / "missing.jsonl")) == []
+
+
+def test_rate_of_known_and_fallback():
+    assert ledger.rate_of("config0", {"blocks_per_sec": 5.5}) == \
+        (5.5, "blocks_per_sec")
+    # unknown config falls back to any *_per_sec field
+    assert ledger.rate_of("config9", {"widgets_per_sec": 3}) == \
+        (3.0, "widgets_per_sec")
+    assert ledger.rate_of("config0", {"error": "boom"}) == (None, None)
+
+
+def test_best_prior_takes_max_per_config():
+    entries = [_entry("t1", config0=50.0, config1=1e6),
+               _entry("t2", config0=80.0),
+               _entry("t3", config0=60.0, config1=2e6)]
+    best = ledger.best_prior(entries)
+    assert best["config0"] == (80.0, "blocks_per_sec")
+    assert best["config1"] == (2e6, "sigs_per_sec")
+
+
+def test_compute_deltas_regression_flag():
+    prior = [_entry("t1", config0=100.0)]
+    # 20% drop beyond the 15% default threshold -> regression
+    d = ledger.compute_deltas(prior, {"config0": {"blocks_per_sec": 80.0}})
+    assert d["config0"]["best_prior"] == 100.0
+    assert abs(d["config0"]["delta_frac"] + 0.2) < 1e-9
+    assert d["config0"]["regression"] is True
+    # 10% drop within threshold -> no regression
+    d = ledger.compute_deltas(prior, {"config0": {"blocks_per_sec": 90.0}})
+    assert d["config0"]["regression"] is False
+    # custom threshold
+    d = ledger.compute_deltas(prior, {"config0": {"blocks_per_sec": 90.0}},
+                              threshold=0.05)
+    assert d["config0"]["regression"] is True
+
+
+def test_compute_deltas_first_run_cannot_regress():
+    d = ledger.compute_deltas([], {"config0": {"blocks_per_sec": 1.0}})
+    assert d["config0"]["best_prior"] is None
+    assert d["config0"]["delta_frac"] is None
+    assert d["config0"]["regression"] is False
+    # errored configs are skipped entirely
+    d = ledger.compute_deltas([], {"config0": {"error": "x"},
+                                   "config1": "not-a-dict"})
+    assert d == {}
+
+
+def test_render_history_shows_deltas_vs_best_prior(tmp_path):
+    path = str(tmp_path / "ledger.jsonl")
+    ledger.append_entry(path, _entry("t1", config0=100.0))
+    ledger.append_entry(path, _entry("t2", config0=50.0))
+    text = ledger.render_history(ledger.load(path))
+    assert "[1] t1 (quick)" in text
+    assert "config0: 100.00 blocks_per_sec" in text
+    assert "-50.0% vs best prior, REGRESSION" in text
+    assert ledger.render_history([]).startswith("ledger is empty")
+
+
+def test_entries_are_single_json_lines(tmp_path):
+    """Each append is one parseable line (O_APPEND semantics): a reader
+    mid-stream sees whole entries only."""
+    path = str(tmp_path / "ledger.jsonl")
+    ledger.append_entry(path, _entry("t1", config0=1.0))
+    with open(path) as f:
+        for line in f:
+            assert isinstance(json.loads(line), dict)

@@ -183,3 +183,82 @@ def test_debug_stacks_and_trace_hooks():
     finally:
         assert trace.stop_device_trace() == d
     assert trace.stop_device_trace() is None
+
+
+def test_gauge_vec_labels():
+    v = metrics.GaugeVec("device")
+    v.labels("cpu:0").set(0.75)
+    v.labels("cpu:1").set(0.5)
+    v.labels("cpu:0").set(0.8)               # overwrite, not accumulate
+    assert v.items() == [("cpu:0", 0.8), ("cpu:1", 0.5)]
+
+
+def test_prom_escape_round_trip():
+    """0.0.4 text format label values: backslash, double-quote and
+    newline must be escaped; plain values pass through untouched."""
+    esc = metrics._prom_escape
+    assert esc("plain-value_1.0") == "plain-value_1.0"
+    assert esc('say "hi"') == 'say \\"hi\\"'
+    assert esc("a\\b") == "a\\\\b"
+    assert esc("line1\nline2") == "line1\\nline2"
+    # order matters: backslash first, so escaped quotes don't double
+    assert esc('\\"') == '\\\\\\"'
+
+
+def test_prometheus_text_escapes_label_values():
+    r = metrics.Registry()
+    r.crypto_rung_calls.labels('we"ird\\rung\n').inc()
+    r.device_util.labels("cpu:0").set(0.25)
+    text = metrics.prometheus_text(r)
+    assert ('tendermint_crypto_rung_calls{rung="we\\"ird\\\\rung\\n"} 1'
+            in text.splitlines())
+    assert 'tendermint_device_util{device="cpu:0"} 0.25' in text
+    # the payload stays line-parseable: every non-comment line is
+    # "name{...} value" or "name value" on ONE physical line
+    for ln in text.splitlines():
+        if ln and not ln.startswith("#"):
+            assert " " in ln and "\n" not in ln
+
+
+def test_prometheus_text_process_start_and_build_info():
+    metrics.set_build_info(test_label="x1")
+    text = metrics.prometheus_text(metrics.Registry())
+    lines = text.splitlines()
+    assert "# TYPE process_start_time_seconds gauge" in lines
+    (start_ln,) = [ln for ln in lines
+                   if ln.startswith("process_start_time_seconds ")]
+    assert float(start_ln.split()[1]) > 1e9   # epoch seconds, not uptime
+    (info_ln,) = [ln for ln in lines
+                  if ln.startswith("tendermint_build_info{")]
+    assert info_ln.endswith(" 1")
+    assert 'test_label="x1"' in info_ln
+    assert 'version="' in info_ln
+
+
+def test_set_build_info_skips_none_and_stringifies():
+    metrics.set_build_info(devices=4, skipme=None)
+    with metrics._BUILD_INFO_LOCK:
+        info = dict(metrics._BUILD_INFO)
+    assert info["devices"] == "4"
+    assert "skipme" not in info
+
+
+def test_registry_snapshot_has_xla_and_transfer_counters():
+    r = metrics.Registry()
+    r.xla_compiles.inc()
+    r.xla_compile_seconds.observe(2.0)
+    r.xla_cache_hits.inc(3)
+    r.xla_cache_misses.inc()
+    r.h2d_bytes.inc(1024)
+    r.d2h_bytes.inc(16)
+    r.device_util.labels("cpu:0").set(0.5)
+    r.bench_regression.set(-0.2)
+    snap = r.snapshot()
+    assert snap["xla_compiles"] == 1
+    assert snap["xla_compile_seconds_mean"] == 2.0
+    assert snap["xla_cache_hits"] == 3
+    assert snap["xla_cache_misses"] == 1
+    assert snap["h2d_bytes"] == 1024
+    assert snap["d2h_bytes"] == 16
+    assert snap["device_util"] == {"cpu:0": 0.5}
+    assert snap["bench_regression"] == -0.2

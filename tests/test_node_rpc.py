@@ -210,3 +210,60 @@ def test_validators_route_accum_snapshot(node, client):
         (v,) = vals["validators"]
         assert v["accum"] == 0
         assert v["voting_power"] == 10
+
+
+def test_debug_flight_recorder_filters(node, client):
+    """Server-side name/last filters: a 16k-span ring answers questions
+    about its tail without shipping the whole ring over the wire."""
+    from tendermint_tpu.rpc.routes import Routes
+    from tendermint_tpu.utils import tracing
+    node.config.rpc.unsafe = True
+    try:
+        r = Routes(node)
+        for i in range(5):
+            tracing.RECORDER.record(f"filt.me{i}", ts_s=1000.0 + i,
+                                    dur_s=0.1)
+        out = r.debug_flight_recorder({"name": "filt.me"})
+        assert [s["name"] for s in out["spans"]] == \
+            [f"filt.me{i}" for i in range(5)]
+        out = r.debug_flight_recorder({"name": "filt.me", "last": 2})
+        assert [s["name"] for s in out["spans"]] == \
+            ["filt.me3", "filt.me4"]
+        chrome = r.debug_flight_recorder(
+            {"format": "chrome", "name": "filt.me", "last": 1})
+        evs = chrome["trace"]["traceEvents"]
+        assert [e["name"] for e in evs if e["ph"] != "M"] == ["filt.me4"]
+        assert any(e["ph"] == "M" for e in evs)     # metadata survives
+    finally:
+        node.config.rpc.unsafe = False
+
+
+def test_debug_doctor_and_bench_history_routes(node, client, tmp_path,
+                                               monkeypatch):
+    """debug_doctor reports attribution over the live recorder;
+    debug_bench_history serves the ledger with path containment (a
+    ledger param may not escape the node's working directory)."""
+    from tendermint_tpu.rpc.routes import Routes
+    from tendermint_tpu.utils import ledger, tracing
+    node.config.rpc.unsafe = True
+    try:
+        r = Routes(node)
+        assert "debug_doctor" in r.table
+        assert "debug_bench_history" in r.table
+        tracing.RECORDER.record("scalar.verify", ts_s=2000.0, dur_s=1.0)
+        rep = r.debug_doctor({})["report"]
+        assert rep["schema"] == "tpu-bft-doctor/1"
+        assert rep["headline_gap"]["scalar_tail"] >= 1.0
+        monkeypatch.chdir(tmp_path)
+        ledger.append_entry("led.jsonl",
+                            {"configs": {"config0":
+                                         {"blocks_per_sec": 5.0}}})
+        out = r.debug_bench_history({"ledger": "led.jsonl"})
+        assert out["count"] == 1
+        assert out["latest_deltas"]["config0"]["rate"] == 5.0
+        with pytest.raises(ValueError):
+            r.debug_bench_history({"ledger": "../etc/passwd"})
+        with pytest.raises(ValueError):
+            r.debug_bench_history({"ledger": "a/b.jsonl"})
+    finally:
+        node.config.rpc.unsafe = False

@@ -132,6 +132,59 @@ def test_capacity_validation():
         FlightRecorder(capacity=0)
 
 
+def test_category_inference_longest_prefix():
+    from tendermint_tpu.utils import tracing
+    assert tracing.default_category("xla.compile") == tracing.CAT_COMPILE
+    assert tracing.default_category("transfer.h2d") == tracing.CAT_TRANSFER
+    assert tracing.default_category("scalar.verify") == tracing.CAT_SCALAR
+    assert tracing.default_category("verify.batch") == tracing.CAT_DEVICE
+    assert tracing.default_category("verify.dispatch") == \
+        tracing.CAT_DISPATCH
+    assert tracing.default_category("bench.prep") == tracing.CAT_PREP
+    assert tracing.default_category("bench.apply") == tracing.CAT_APPLY
+    # window-boundary and unknown names stay uncategorized
+    assert tracing.default_category("fastsync.window") is None
+    assert tracing.default_category("wal.write") is None
+
+
+def test_span_cat_and_lane_in_snapshot():
+    """cat/lane are reserved span() keywords: they land as top-level
+    snapshot fields, never in args (the args contract above must hold)."""
+    rec = FlightRecorder(capacity=8)
+    with rec.span("verify.batch", lanes=4):
+        pass
+    with rec.span("custom.op", cat="scalar", lane="worker-3", n=1):
+        pass
+    a, b = rec.snapshot()
+    assert a["cat"] == "device"               # derived from name
+    assert a["lane"]                          # defaults to thread name
+    assert a["args"] == {"lanes": 4}
+    assert b["cat"] == "scalar"               # explicit override
+    assert b["lane"] == "worker-3"
+    assert b["args"] == {"n": 1}
+
+
+def test_chrome_trace_carries_cat():
+    rec = FlightRecorder(capacity=8)
+    with rec.span("xla.compile", entry="verify_batch"):
+        pass
+    with rec.span("uncategorized.op"):
+        pass
+    evs = rec.to_chrome_trace()["traceEvents"]
+    x = next(e for e in evs if e.get("name") == "xla.compile")
+    assert x["cat"] == "compile"
+    u = next(e for e in evs if e.get("name") == "uncategorized.op")
+    assert "cat" not in u
+
+
+def test_perf_to_epoch_aligns_with_span_clock():
+    import time
+    from tendermint_tpu.utils import tracing
+    p = time.perf_counter()
+    w = time.time()
+    assert abs(tracing.perf_to_epoch(p) - w) < 1.0
+
+
 def test_grown_timeout_zero_base_no_crash():
     """Regression: `_grown` divided timeout_max by the base timeout; a
     config with base 0 (skip a step instantly) crashed with
