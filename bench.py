@@ -232,8 +232,8 @@ def _build_bench_chain(n_vals: int, n_blocks: int, txs_per_block: int = 1):
     sys.path.insert(0, "tests")
     from chainutil import (build_chain, kvstore_app_hashes, make_genesis,
                            make_validators)
-    with tracing.span("bench.fixture_build", n_vals=n_vals,
-                      n_blocks=n_blocks, builder="host"):
+    with tracing.span("bench.fixture_build", cat=tracing.CAT_NONE,
+                      n_vals=n_vals, n_blocks=n_blocks, builder="host"):
         privs, vs = make_validators(n_vals)
         gen = make_genesis("bench-chain", privs)
         hashes = kvstore_app_hashes(n_blocks, txs_per_block)
@@ -1248,7 +1248,8 @@ def main() -> None:
            else ([1, 3] if args.quick else [0, 1, 2, 3, 4]))
     for c in run:
         try:
-            with tracing.span("bench.config", config=c):
+            with tracing.span("bench.config", cat=tracing.CAT_NONE,
+                              config=c):
                 res = configs[c](args.quick)
         except Exception as e:
             log(f"[bench] config {c} FAILED: {e}")

@@ -54,7 +54,7 @@ def register_app(name: str, cls: type) -> None:
 
 def create_app(name: str) -> Application:
     """In-proc app by name (reference `proxy/client.go:65-79`)."""
-    from tendermint_tpu.abci.apps import counter, kvstore  # registers
+    from tendermint_tpu.abci.apps import counter, kvstore  # noqa: F401 - registers
     if name not in _REGISTRY:
         raise ValueError(f"unknown in-proc app {name!r}; "
                          f"known: {sorted(_REGISTRY)}")

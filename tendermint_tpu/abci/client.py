@@ -15,6 +15,9 @@ from tendermint_tpu.abci import wire
 from tendermint_tpu.abci.types import (RequestBeginBlock, ResponseEndBlock,
                                        ResponseInfo, ResponseQuery, Result)
 from tendermint_tpu.types.codec import Reader, lp_bytes, u64
+from tendermint_tpu.utils.log import get_logger
+
+log = get_logger("abci")
 
 
 class ABCIClientError(Exception):
@@ -22,10 +25,13 @@ class ABCIClientError(Exception):
 
 
 class SocketAppConn:
-    """One connection; request/response serialized by a lock."""
+    """One connection; request/response serialized by a lock.  `name`
+    identifies which of the three proxy connections this is (mempool /
+    consensus / query) so a dead socket's errors say which plane died."""
 
-    def __init__(self, addr: str, timeout: float = 10.0):
+    def __init__(self, addr: str, timeout: float = 10.0, name: str = ""):
         assert addr.startswith("tcp://")
+        self.name = name or addr
         host, port = addr[6:].rsplit(":", 1)
         self._sock = socket.create_connection((host, int(port)),
                                               timeout=timeout)
@@ -35,13 +41,23 @@ class SocketAppConn:
     def close(self) -> None:
         try:
             self._sock.close()
-        except OSError:
-            pass
+        except OSError as e:
+            # close failures can't be retried, but a socket that won't
+            # even close is worth a breadcrumb when the app misbehaves
+            log.debug("abci conn close failed", conn=self.name,
+                      err=str(e))
 
     def _call(self, msg_type: int, payload: bytes = b"") -> bytes:
-        with self._lock:
-            wire.write_frame(self._sock, msg_type, payload)
-            resp_type, resp = wire.read_frame(self._sock)
+        try:
+            with self._lock:
+                wire.write_frame(self._sock, msg_type, payload)
+                resp_type, resp = wire.read_frame(self._sock)
+        except (OSError, EOFError) as e:
+            # name the plane and the request: "consensus conn died on
+            # msg 0x12" localizes an app crash to the exact call
+            raise ABCIClientError(
+                f"abci {self.name} connection failed on request "
+                f"type {msg_type}: {type(e).__name__}: {e}") from e
         if resp_type == wire.MSG_EXCEPTION:
             raise ABCIClientError(Reader(resp).lp_bytes().decode())
         if resp_type != msg_type:
@@ -92,6 +108,6 @@ class SocketAppConn:
 def new_socket_app_conns(addr: str):
     """Three sockets to one app server (mempool / consensus / query)."""
     from tendermint_tpu.proxy import AppConns
-    return AppConns(mempool=SocketAppConn(addr),
-                    consensus=SocketAppConn(addr),
-                    query=SocketAppConn(addr))
+    return AppConns(mempool=SocketAppConn(addr, name="mempool"),
+                    consensus=SocketAppConn(addr, name="consensus"),
+                    query=SocketAppConn(addr, name="query"))

@@ -14,7 +14,6 @@ import threading
 
 from tendermint_tpu.abci import wire
 from tendermint_tpu.abci.app import Application
-from tendermint_tpu.abci.types import Result
 from tendermint_tpu.types.codec import Reader, lp_bytes, u64
 
 

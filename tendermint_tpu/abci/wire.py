@@ -14,7 +14,7 @@ import socket
 import struct
 
 from tendermint_tpu.abci.types import (RequestBeginBlock, ResponseEndBlock,
-                                       ResponseInfo, ResponseQuery, Result,
+                                       ResponseInfo, ResponseQuery,
                                        Validator)
 from tendermint_tpu.types.block import Header
 from tendermint_tpu.types.codec import Reader, i64, lp_bytes, u32, u64, u8

@@ -12,10 +12,9 @@ which batches naturally with the device-verify window.
 
 from __future__ import annotations
 
-import threading
 import time
 
-from tendermint_tpu.utils import tracing
+from tendermint_tpu.utils import lockwitness, tracing
 from tendermint_tpu.utils.log import get_logger
 
 log = get_logger("blockpool")
@@ -50,7 +49,8 @@ class BlockPool:
         self._peer_pending: dict[str, int] = {}
         self._peer_timeouts: dict[str, int] = {}
         self._peer_meters: dict[str, object] = {}   # peer_id -> Meter
-        self._lock = threading.Lock()
+        self._lock = lockwitness.new_lock("blockpool.lock",
+                                          reentrant=False)
         self.on_evict = None                  # cb(peer_id, reason)
 
     # -- peers ----------------------------------------------------------

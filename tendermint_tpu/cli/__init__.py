@@ -480,6 +480,58 @@ def cmd_bench_history(args) -> int:
     return 1 if not entries else 0
 
 
+def cmd_lint(args) -> int:
+    """Run the tmlint static checks (tendermint_tpu/analysis/): lock
+    discipline, JAX hot-path hygiene, RPC route gating, span/metric
+    conventions.  Exit 0 when every finding is baselined or suppressed,
+    1 when fresh findings exist, 2 when a lint path is missing."""
+    from tendermint_tpu.analysis import (all_rules, baseline_path,
+                                         lint_paths, load_baseline,
+                                         save_baseline)
+    if args.list_rules:
+        for name, desc in all_rules():
+            print(f"{name:24s} {desc}")
+        return 0
+    import tendermint_tpu
+    pkg_dir = os.path.dirname(os.path.abspath(tendermint_tpu.__file__))
+    repo_root = os.path.dirname(pkg_dir)
+    if args.paths:
+        paths, root = args.paths, None
+    else:
+        paths = [pkg_dir]
+        bench = os.path.join(repo_root, "bench.py")
+        if os.path.exists(bench):
+            paths.append(bench)
+        root = repo_root
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print(f"lint: no such path: {', '.join(missing)}",
+              file=sys.stderr)
+        return 2
+    result = lint_paths(paths, root=root,
+                        rules=args.rules.split(",") if args.rules
+                        else None)
+    bl_path = args.baseline or baseline_path()
+    if args.update_baseline:
+        save_baseline(result.findings, bl_path)
+        print(f"baseline written: {len(result.findings)} findings "
+              f"grandfathered at {bl_path}")
+        return 0
+    baseline = load_baseline(bl_path)
+    fresh = result.fresh(baseline)
+    if args.json:
+        print(json.dumps(result.to_dict(baseline), indent=1))
+    else:
+        for f in result.findings:
+            tag = "" if f.fingerprint not in baseline else " [baselined]"
+            print(f.render() + tag)
+        print(f"{result.files} files, {len(result.findings)} findings "
+              f"({len(fresh)} fresh, {result.suppressed} suppressed)")
+        for e in result.errors:
+            print(f"parse error: {e}", file=sys.stderr)
+    return 1 if fresh or result.errors else 0
+
+
 def cmd_version(args) -> int:
     print(__version__)
     return 0
@@ -620,6 +672,27 @@ def main(argv=None) -> int:
     sp.add_argument("--ledger", default="BENCH_LEDGER.jsonl",
                     help="ledger JSONL path (bench.py --ledger)")
     sp.set_defaults(fn=cmd_bench_history)
+
+    sp = sub.add_parser("lint",
+                        help="run the tmlint static invariant checks "
+                             "(lock discipline, JAX hot-path hygiene, "
+                             "route gating, span/metric conventions)")
+    sp.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: the installed "
+                         "tendermint_tpu package + bench.py)")
+    sp.add_argument("--json", action="store_true",
+                    help="machine-readable findings document")
+    sp.add_argument("--rules", default="",
+                    help="comma-separated rule subset to run")
+    sp.add_argument("--baseline", default="",
+                    help="baseline file (default: "
+                         "tendermint_tpu/analysis/baseline.json)")
+    sp.add_argument("--update-baseline", action="store_true",
+                    dest="update_baseline",
+                    help="grandfather the current findings and exit 0")
+    sp.add_argument("--list-rules", action="store_true",
+                    dest="list_rules", help="print the rule catalog")
+    sp.set_defaults(fn=cmd_lint)
 
     sp = sub.add_parser("version", help="print version")
     sp.set_defaults(fn=cmd_version)

@@ -11,7 +11,6 @@ from __future__ import annotations
 import threading
 
 from tendermint_tpu.types import TYPE_PRECOMMIT, TYPE_PREVOTE, VoteSet
-from tendermint_tpu.types.vote import ErrVoteConflict
 
 
 class HeightVoteSet:

@@ -11,7 +11,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 
 from tendermint_tpu.types import BlockID, Proposal, Vote
-from tendermint_tpu.types.codec import Reader, lp_bytes, u32, u64, u8
+from tendermint_tpu.types.codec import Reader, u32, u64, u8
 from tendermint_tpu.types.part_set import Part
 
 TAG_PROPOSAL = 0x01

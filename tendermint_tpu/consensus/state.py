@@ -26,7 +26,7 @@ from tendermint_tpu import config as config_mod
 from tendermint_tpu.consensus import messages as M
 from tendermint_tpu.consensus.height_vote_set import HeightVoteSet
 from tendermint_tpu.consensus.ticker import TimeoutInfo, TimeoutTicker
-from tendermint_tpu.consensus.wal import WAL, REC_ENDHEIGHT, REC_MESSAGE, REC_TIMEOUT
+from tendermint_tpu.consensus.wal import WAL, REC_MESSAGE, REC_TIMEOUT
 from tendermint_tpu.state import execution
 from tendermint_tpu.state.state import State
 from tendermint_tpu.types import (Block, BlockID, Commit, EMPTY_COMMIT,
@@ -36,7 +36,7 @@ from tendermint_tpu.types import events as ev
 from tendermint_tpu.types.events import EventCache, EventSwitch
 from tendermint_tpu.types.priv_validator import DoubleSignError
 from tendermint_tpu.types.vote import ErrVoteConflict
-from tendermint_tpu.utils import tracing
+from tendermint_tpu.utils import lockwitness, tracing
 from tendermint_tpu.utils.chaos import DeviceFault
 from tendermint_tpu.utils.fail import fail_point
 from tendermint_tpu.utils.log import get_logger
@@ -102,7 +102,7 @@ class ConsensusState:
         self._ticker = ticker or TimeoutTicker(self._on_timeout_fire)
         self._thread: threading.Thread | None = None
         self._stopped = threading.Event()
-        self._mtx = threading.RLock()
+        self._mtx = lockwitness.new_lock("consensus.mtx")
 
         self.wal = WAL(wal_path, light=cfg.wal_light) if wal_path else None
         self._replay_mode = False
@@ -450,6 +450,7 @@ class ConsensusState:
             return set()
         try:
             with tracing.span("consensus.vote_microbatch",
+                              cat=tracing.CAT_DEVICE,
                               height=self.height, lanes=len(sel)):
                 ok = batch_verify_vote_sigs(self.state.chain_id, vals, sel)
         except DeviceFault as e:
@@ -939,8 +940,8 @@ class ConsensusState:
 
         state_copy = self.state.copy()
         event_cache = EventCache(self.evsw)
-        with tracing.span("consensus.apply", height=block.height,
-                          txs=len(block.txs)):
+        with tracing.span("consensus.apply", cat=tracing.CAT_APPLY,
+                          height=block.height, txs=len(block.txs)):
             execution.apply_block(state_copy, event_cache, self.proxy,
                                   block, parts.header, self.mempool,
                                   tx_indexer=self.tx_indexer)

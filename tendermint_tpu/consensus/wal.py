@@ -45,20 +45,22 @@ class WAL:
         self._f.write(struct.pack(">II", len(body), crc) + body)
 
     def save_message(self, payload: bytes) -> None:
-        with tracing.span("wal.write", kind="message",
-                          bytes=len(payload)):
+        with tracing.span("wal.write", cat=tracing.CAT_NONE,
+                          kind="message", bytes=len(payload)):
             self._write(REC_MESSAGE, payload)
             self._sync()
 
     def save_timeout(self, height: int, round_: int, step: int) -> None:
-        with tracing.span("wal.write", kind="timeout", height=height):
+        with tracing.span("wal.write", cat=tracing.CAT_NONE,
+                          kind="timeout", height=height):
             self._write(REC_TIMEOUT,
                         struct.pack(">QIB", height, round_, step))
             self._sync()
 
     def write_end_height(self, height: int) -> None:
         """Reference `:97-103`: marks height as irreversibly committed."""
-        with tracing.span("wal.write", kind="end_height", height=height):
+        with tracing.span("wal.write", cat=tracing.CAT_NONE,
+                          kind="end_height", height=height):
             self._write(REC_ENDHEIGHT, struct.pack(">Q", height))
             self._sync()
 

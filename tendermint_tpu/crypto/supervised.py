@@ -230,7 +230,10 @@ class SupervisedBackend:
         t0 = time.perf_counter()
         rung.calls += 1
         REGISTRY.crypto_rung_calls.labels(rung.name).inc()
-        with tracing.span("crypto.call", rung=rung.name, method=method):
+        # CAT_NONE: the supervised wrapper's wall clock double-counts the
+        # categorized spans the backend emits inside it
+        with tracing.span("crypto.call", cat=tracing.CAT_NONE,
+                          rung=rung.name, method=method):
             if not rung.is_device:
                 out = run()
             else:

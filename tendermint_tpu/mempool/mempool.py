@@ -14,10 +14,10 @@ removal on update, safe concurrent CheckTx from RPC threads.
 from __future__ import annotations
 
 import os
-import threading
 from collections import OrderedDict
 
 from tendermint_tpu.types.tx import Tx
+from tendermint_tpu.utils import lockwitness
 
 
 class Mempool:
@@ -28,7 +28,7 @@ class Mempool:
         self._txs: OrderedDict[bytes, bytes] = OrderedDict()  # hash -> tx
         self._cache: OrderedDict[bytes, None] = OrderedDict()
         self._cache_size = cache_size
-        self._lock = threading.RLock()
+        self._lock = lockwitness.new_lock("mempool.lock")
         self._height = 0
         self._notified_available = False
         self._txs_available_cb = None
@@ -190,27 +190,30 @@ class Mempool:
     # -- post-commit -----------------------------------------------------
     def update(self, height: int, committed_txs: list[bytes]) -> None:
         """Drop committed txs, recheck the rest (reference `:329-391`).
-        Caller (apply_block) already holds the lock."""
-        self._height = height
-        self._notified_available = False
-        for tx in committed_txs:
-            h = Tx(tx).hash
-            self._txs.pop(h, None)
-            self._tx_heights.pop(h, None)
-            self._cache[h] = None   # committed: permanently deduped
-        if self.recheck_enabled and self._txs:
-            survivors = OrderedDict()
-            for h, tx in self._txs.items():
-                if self.proxy.check_tx(tx).is_ok:
-                    survivors[h] = tx
-                else:
-                    self._tx_heights.pop(h, None)
-            self._txs = survivors
-        # compact the journal to the surviving pool: committed txs must
-        # not be re-admitted (and re-EXECUTED) by a later recover_wal
-        self._rewrite_wal()
-        if self._txs:
-            self._notify_available()
+        Caller (apply_block) already holds the lock; _lock is an RLock,
+        so taking it again here is free — and keeps the pool consistent
+        if update is ever reached without the outer lock()."""
+        with self._lock:
+            self._height = height
+            self._notified_available = False
+            for tx in committed_txs:
+                h = Tx(tx).hash
+                self._txs.pop(h, None)
+                self._tx_heights.pop(h, None)
+                self._cache[h] = None   # committed: permanently deduped
+            if self.recheck_enabled and self._txs:
+                survivors = OrderedDict()
+                for h, tx in self._txs.items():
+                    if self.proxy.check_tx(tx).is_ok:
+                        survivors[h] = tx
+                    else:
+                        self._tx_heights.pop(h, None)
+                self._txs = survivors
+            # compact the journal to the surviving pool: committed txs
+            # must not be re-admitted (re-EXECUTED) by recover_wal
+            self._rewrite_wal()
+            if self._txs:
+                self._notify_available()
 
     def _rewrite_wal(self) -> None:
         """Atomically rewrite the journal to exactly the current pool

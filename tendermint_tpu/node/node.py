@@ -20,7 +20,7 @@ from tendermint_tpu.crypto import backend as crypto_backend
 from tendermint_tpu.mempool.mempool import Mempool
 from tendermint_tpu.proxy import ClientCreator
 from tendermint_tpu.state.state import get_state
-from tendermint_tpu.state.txindex import KVTxIndexer, NullTxIndexer
+from tendermint_tpu.state.txindex import KVTxIndexer
 from tendermint_tpu.types import GenesisDoc, PrivValidator
 from tendermint_tpu.types.events import EventSwitch
 from tendermint_tpu.utils.db import new_db

@@ -97,10 +97,11 @@ class MConnection:
                  on_receive, on_error=None,
                  send_rate: int = 512_000, recv_rate: int = 512_000,
                  ping_interval: float = 40.0,
-                 flush_throttle: float = 0.1):
+                 flush_throttle: float = 0.1, label: str = ""):
         self.conn = conn
         self.on_receive = on_receive
         self.on_error = on_error
+        self.label = label           # peer id/addr, for death reports
         self._channels = {d.id: _Channel(d) for d in chan_descs}
         self._send_limiter = _RateLimiter(send_rate)
         self._recv_limiter = _RateLimiter(recv_rate)
@@ -137,6 +138,17 @@ class MConnection:
             if self._errored:
                 return
             self._errored = True
+        # stop() closes the socket, which makes the OTHER routine's
+        # blocking read/write raise too — that second death is expected
+        # and already deduped above.  A death after stop() was requested
+        # is normal teardown (debug); anything else is a real peer error
+        # and must be attributable even when no on_error is wired.
+        if self._stopped.is_set():
+            log.debug("connection closed", peer=self.label or "?",
+                      cause=type(exc).__name__)
+        else:
+            log.error("connection died", peer=self.label or "?",
+                      err=str(exc) or type(exc).__name__)
         self.stop()
         if self.on_error is not None:
             self.on_error(exc)

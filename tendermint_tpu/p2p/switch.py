@@ -21,6 +21,7 @@ from tendermint_tpu.p2p.peer import Peer, Reactor
 from tendermint_tpu.p2p.secret import SecretConnection
 from tendermint_tpu.p2p.types import NetAddress, NodeInfo
 from tendermint_tpu.types.keys import PrivKey
+from tendermint_tpu.utils import lockwitness
 from tendermint_tpu.utils.log import get_logger
 from tendermint_tpu.utils.metrics import REGISTRY
 
@@ -43,7 +44,7 @@ class Switch:
         self._reactors_by_ch: dict[int, Reactor] = {}
         self._chan_descs: list = []
         self._peers: dict[str, Peer] = {}
-        self._peers_lock = threading.RLock()
+        self._peers_lock = lockwitness.new_lock("switch.peers")
         self._listener: transport.Listener | None = None
         self._stopped = threading.Event()
         self._dialing: set[str] = set()
@@ -259,7 +260,8 @@ class Switch:
                 self.stop_peer_for_error(peer_holder[0], exc)
 
         mconn = MConnection(conn, self._chan_descs, on_receive,
-                            on_error=on_error, **mconn_kwargs)
+                            on_error=on_error, label=info.id[:12],
+                            **mconn_kwargs)
         peer = Peer(info, mconn, outbound, persistent)
         peer_holder.append(peer)
         with self._peers_lock:

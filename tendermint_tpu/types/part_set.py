@@ -156,7 +156,6 @@ def _device_full_chunk_hashes(chunks: list[bytes],
     if cb.get_backend().name != "tpu":
         return None
     try:
-        import jax.numpy as jnp
         from tendermint_tpu.ops import merkle as dev_merkle
     except ImportError:                  # pragma: no cover - env dependent
         return None

@@ -141,13 +141,16 @@ class PrivValidator:
         return self.priv_key.sign(hb.sign_bytes(chain_id))
 
     def reset(self) -> None:
-        """unsafe_reset: clear the HRS state (testing only)."""
-        self.last_height = 0
-        self.last_round = 0
-        self.last_step = STEP_NONE
-        self.last_sign_bytes = b""
-        self.last_signature = b""
-        self.save()
+        """unsafe_reset: clear the HRS state (testing only).  Taken
+        under the lock like _sign_hrs — a signer mid-HRS-check must see
+        either the old state or the fully-reset one, never a torn mix."""
+        with self._lock:
+            self.last_height = 0
+            self.last_round = 0
+            self.last_step = STEP_NONE
+            self.last_sign_bytes = b""
+            self.last_signature = b""
+            self.save()
 
     def __str__(self):
         return f"PrivValidator[{self.address.hex()[:8]}]"

@@ -10,13 +10,12 @@ single votes verify scalar host-side, bulk ingestion goes through
 
 from __future__ import annotations
 
-from dataclasses import dataclass, replace
+from dataclasses import dataclass
 
 import numpy as np
 
 from tendermint_tpu.types import canonical
 from tendermint_tpu.types.codec import Reader, lp_bytes, u32, u64, u8
-from tendermint_tpu.types.part_set import PartSetHeader
 from tendermint_tpu.utils.chaos import DeviceFault
 
 # re-exported vote types

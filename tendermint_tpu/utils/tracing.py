@@ -63,6 +63,11 @@ CAT_APPLY = "apply"        # host-side ABCI/store application
 CAT_COMPILE = "compile"    # XLA compile / first-call executables
 CAT_TRANSFER = "transfer"  # host<->device copies
 CAT_SCALAR = "scalar"      # scalar/python fallback crypto
+# Deliberately-uncategorized: host bookkeeping spans (WAL writes,
+# supervised-ladder wrappers whose inner spans carry the categories).
+# Passing cat=CAT_NONE skips prefix inference AND keeps the span out of
+# the attribution partition — unlike cat=None, which means "infer".
+CAT_NONE = ""
 
 _CAT_BY_PREFIX = (
     ("xla.", CAT_COMPILE),
@@ -70,6 +75,8 @@ _CAT_BY_PREFIX = (
     ("scalar.", CAT_SCALAR),
     ("verify.dispatch", CAT_DISPATCH),
     ("verify.collect", CAT_DEVICE),
+    ("fastsync.verify", CAT_DEVICE),
+    ("bench.verify", CAT_DEVICE),
     ("verify.batch", CAT_DEVICE),
     ("verify.grouped", CAT_DEVICE),
     ("sign.batch", CAT_DEVICE),

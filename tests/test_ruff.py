@@ -1,0 +1,23 @@
+"""Run the curated ruff surface (ruff.toml) over the repo when ruff is
+available.  The container image may not ship ruff; the test skips
+cleanly rather than failing on a missing tool — the tmlint suite
+(test_tmlint_repo.py) is the always-on gate."""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+ruff = shutil.which("ruff")
+
+
+@pytest.mark.lint
+@pytest.mark.skipif(ruff is None, reason="ruff not installed")
+def test_ruff_check_clean():
+    out = subprocess.run(
+        [ruff, "check", "--no-cache", "."],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
