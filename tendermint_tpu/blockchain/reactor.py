@@ -26,7 +26,8 @@ from tendermint_tpu.p2p.types import ChannelDescriptor
 from tendermint_tpu.state import execution
 from tendermint_tpu.types import BlockID
 from tendermint_tpu.types.part_set import from_data_batched
-from tendermint_tpu.types.validator import (CommitPowerError,
+from tendermint_tpu.types.validator import (CommitFormatError,
+                                            CommitPowerError,
                                             CommitSignatureError,
                                             verify_commits_batched)
 from tendermint_tpu.utils import tracing
@@ -283,6 +284,14 @@ class BlockchainReactor(Reactor):
                 # the next tick retry once a rung recovers.
                 log.warn("device fault during commit verify; will retry",
                          height=blocks[0].height, error=str(e)[:200])
+                return False
+            except CommitFormatError as e:
+                # a structurally-wrong commit (stale finality proof, bad
+                # size) rides in the successor block's LastCommit — same
+                # blame as a pruned commit: height+1's deliverer lied
+                log.warn("stale/malformed commit; punishing successor's "
+                         "deliverer", height=e.height, error=str(e)[:200])
+                self.pool.redo(e.height + 1)
                 return False
             except CommitSignatureError as e:
                 # the commit for height h rides in block h+1's LastCommit:

@@ -532,6 +532,108 @@ def cmd_lint(args) -> int:
     return 1 if fresh or result.errors else 0
 
 
+def _print_scenario_result(result, as_json: bool) -> None:
+    if as_json:
+        print(json.dumps(result.to_dict(), indent=1))
+        return
+    verdict = "PASS" if result.ok else "FAIL"
+    print(f"{verdict} {result.name} seed={result.seed} "
+          f"({result.duration_s:.1f}s) "
+          f"event_log_hash={result.event_log_hash[:16]}")
+    for f in result.failures:
+        print(f"  FAILED {f}")
+    if result.artifact_dir:
+        print(f"  artifacts: {result.artifact_dir}")
+
+
+def cmd_chaos_list(args) -> int:
+    """Catalogue of registered fault scenarios."""
+    from tendermint_tpu.scenarios import SCENARIOS
+    if args.json:
+        print(json.dumps({
+            name: {"description": sc.description,
+                   "tier": "smoke" if sc.smoke else "stress",
+                   "safety": [n for n, _ in sc.safety],
+                   "liveness": [n for n, _ in sc.liveness]}
+            for name, sc in sorted(SCENARIOS.items())}, indent=1))
+        return 0
+    for name, sc in sorted(SCENARIOS.items()):
+        tier = "smoke " if sc.smoke else "stress"
+        print(f"{name:24s} [{tier}] {sc.description}")
+        print(f"{'':24s}  safety: "
+              + ", ".join(n for n, _ in sc.safety))
+        print(f"{'':24s}  liveness: "
+              + ", ".join(n for n, _ in sc.liveness))
+    return 0
+
+
+def cmd_chaos_run(args) -> int:
+    """Run one scenario; exit 0 when every invariant held.  The same
+    --seed replays the same injected-fault schedule bit-identically
+    (verify with the printed event_log_hash)."""
+    from tendermint_tpu.scenarios import run_scenario
+    result = run_scenario(args.scenario, seed=args.seed,
+                          artifacts=args.artifacts or None,
+                          keep_artifacts=args.keep_artifacts)
+    _print_scenario_result(result, args.json)
+    return 0 if result.ok else 1
+
+
+def cmd_chaos_replay(args) -> int:
+    """Re-run a scenario from a dumped result.json manifest and compare
+    event-log hashes: MATCH means the replayed run injected the exact
+    fault schedule of the original (the seed-replay contract); DIVERGED
+    means the scenario gained nondeterminism and its artifacts can no
+    longer be trusted as reproductions."""
+    from tendermint_tpu.scenarios import run_scenario
+    with open(args.manifest) as f:
+        manifest = json.load(f)
+    name, seed = manifest["scenario"], manifest["seed"]
+    want = manifest["event_log_hash"]
+    result = run_scenario(name, seed=seed,
+                          artifacts=args.artifacts or None,
+                          keep_artifacts=args.keep_artifacts)
+    _print_scenario_result(result, args.json)
+    if result.event_log_hash == want:
+        print(f"MATCH: replay reproduced event log {want[:16]}")
+        return 0 if result.ok else 1
+    print(f"DIVERGED: original {want[:16]} != replay "
+          f"{result.event_log_hash[:16]} — scenario is nondeterministic")
+    return 1
+
+
+def cmd_chaos_smoke(args) -> int:
+    """The fast smoke subset under a wall-clock budget: scenarios run in
+    cheapest-first order and the remainder is SKIPPED (reported, never
+    silently dropped) once the budget is spent.  The faults-tier CI
+    entry point."""
+    import time as _time
+    from tendermint_tpu.scenarios import SCENARIOS, SMOKE_ORDER, run_scenario
+    names = [n for n in SMOKE_ORDER if n in SCENARIOS]
+    names += sorted(n for n, sc in SCENARIOS.items()
+                    if sc.smoke and n not in names)
+    t0 = _time.time()
+    failed, skipped, results = [], [], []
+    for name in names:
+        spent = _time.time() - t0
+        if args.budget and spent >= args.budget:
+            skipped.append(name)
+            continue
+        result = run_scenario(name, seed=args.seed,
+                              artifacts=args.artifacts or None,
+                              keep_artifacts=args.keep_artifacts)
+        results.append(result)
+        _print_scenario_result(result, args.json)
+        if not result.ok:
+            failed.append(name)
+    for name in skipped:
+        print(f"SKIP {name} (budget {args.budget:.0f}s spent)")
+    print(f"chaos smoke: {len(results) - len(failed)}/{len(results)} "
+          f"passed, {len(skipped)} skipped "
+          f"in {_time.time() - t0:.1f}s")
+    return 1 if failed else 0
+
+
 def cmd_version(args) -> int:
     print(__version__)
     return 0
@@ -693,6 +795,59 @@ def main(argv=None) -> int:
     sp.add_argument("--list-rules", action="store_true",
                     dest="list_rules", help="print the rule catalog")
     sp.set_defaults(fn=cmd_lint)
+
+    sp = sub.add_parser("chaos",
+                        help="deterministic fault-scenario harness "
+                             "(byzantine votes, partitions, crash "
+                             "storms, device faults)")
+    chaos_sub = sp.add_subparsers(dest="chaos_command", required=True)
+
+    def _chaos_common(csp, scenario_arg: bool):
+        from tendermint_tpu.scenarios.engine import DEFAULT_SEED
+        if scenario_arg:
+            csp.add_argument("--scenario", required=True,
+                             help="scenario name (see `chaos list`)")
+        csp.add_argument("--seed", type=int, default=DEFAULT_SEED,
+                         help="scenario seed; the same seed replays the "
+                              "same fault schedule (default: %(default)s)")
+        csp.add_argument("--artifacts", default="",
+                         help="artifact root (default: "
+                              "$TM_SCENARIO_ARTIFACTS or "
+                              "./chaos_artifacts)")
+        csp.add_argument("--keep-artifacts", dest="keep_artifacts",
+                         action="store_true",
+                         help="dump trace/metrics/events/result even on "
+                              "a passing run")
+        csp.add_argument("--json", action="store_true",
+                         help="machine-readable result")
+
+    csp = chaos_sub.add_parser("list", help="catalogue of scenarios")
+    csp.add_argument("--json", action="store_true")
+    csp.set_defaults(fn=cmd_chaos_list)
+
+    csp = chaos_sub.add_parser("run", help="run one scenario")
+    _chaos_common(csp, scenario_arg=True)
+    csp.set_defaults(fn=cmd_chaos_run)
+
+    csp = chaos_sub.add_parser(
+        "replay", help="re-run from a dumped result.json and check the "
+                       "event-log hash matches")
+    csp.add_argument("--manifest", required=True,
+                     help="path to a result.json from a prior run")
+    csp.add_argument("--artifacts", default="")
+    csp.add_argument("--keep-artifacts", dest="keep_artifacts",
+                     action="store_true")
+    csp.add_argument("--json", action="store_true")
+    csp.set_defaults(fn=cmd_chaos_replay)
+
+    csp = chaos_sub.add_parser(
+        "smoke", help="run the smoke subset under a time budget")
+    _chaos_common(csp, scenario_arg=False)
+    csp.add_argument("--budget", type=float, default=300.0,
+                     help="wall-clock budget in seconds; scenarios that "
+                          "don't fit are reported as skipped "
+                          "(default: %(default)s)")
+    csp.set_defaults(fn=cmd_chaos_smoke)
 
     sp = sub.add_parser("version", help="print version")
     sp.set_defaults(fn=cmd_version)

@@ -60,6 +60,12 @@ class P2PConfig:
     handshake_timeout_s: float = 20.0
     dial_timeout_s: float = 3.0
     fuzz: bool = False
+    # FuzzedConnection profile when fuzz=True (write-direction drop +
+    # both-direction delay; the RNG seed is derived from the installed
+    # ChaosConfig scenario seed — see p2p/fuzz.py)
+    fuzz_drop_prob: float = 0.05
+    fuzz_delay_prob: float = 0.1
+    fuzz_max_delay: float = 0.05
 
 
 @dataclass

@@ -115,7 +115,9 @@ class SupervisedBackend:
         self.call_timeout_s = call_timeout_s
         self.retries = max(0, retries)
         self.spot_check_every = max(0, spot_check_every)
-        self.chaos = chaos if chaos is not None else CryptoChaos.from_env()
+        # explicit kwarg > installed ChaosConfig (scenario engine) >
+        # TM_CHAOS_CRYPTO env (standalone node); see utils/chaos.py
+        self.chaos = chaos if chaos is not None else CryptoChaos.current()
         self._lock = threading.Lock()
         self._spot_count = 0
         # timeout enforcement: the rung call runs on a worker and we wait

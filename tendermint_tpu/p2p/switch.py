@@ -235,7 +235,11 @@ class Switch:
         cfg = self.config
         conn = raw_conn
         if cfg is not None and cfg.fuzz:
-            conn = FuzzedConnection(conn, drop_prob=0.05, delay_prob=0.1)
+            conn = FuzzedConnection(
+                conn,
+                drop_prob=getattr(cfg, "fuzz_drop_prob", 0.05),
+                delay_prob=getattr(cfg, "fuzz_delay_prob", 0.1),
+                max_delay=getattr(cfg, "fuzz_max_delay", 0.05))
         conn = SecretConnection(conn, self.node_key)
         info = self._handshake(conn)
         if info.pub_key != conn.remote_pub_key:

@@ -1,0 +1,21 @@
+"""Deterministic Byzantine + failure scenario harness.
+
+`run_scenario(name, seed)` runs one registered scenario: a declarative
+composition of fault injectors (byzantine vote streams, evidence
+floods, stale/partial-commit replay, partitions, crash-restart storms,
+device-fault storms) with a post-mortem of safety and liveness
+invariants and flight-recorder artifacts on failure.  See `engine.py`
+for the seed-replay contract and `catalog.py` for the shipped
+scenarios; drive from the command line with `cli chaos`.
+"""
+
+from tendermint_tpu.scenarios.engine import (DEFAULT_SEED, SCENARIOS,
+                                             InvariantViolation,
+                                             ScenarioResult, artifacts_root,
+                                             register, run_scenario)
+from tendermint_tpu.scenarios import catalog  # registers the shipped set
+from tendermint_tpu.scenarios.catalog import SMOKE_ORDER
+
+__all__ = ["DEFAULT_SEED", "SCENARIOS", "SMOKE_ORDER",
+           "InvariantViolation", "ScenarioResult", "artifacts_root",
+           "catalog", "register", "run_scenario"]

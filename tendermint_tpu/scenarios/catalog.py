@@ -1,0 +1,632 @@
+"""The shipped scenario catalogue.
+
+Each scenario composes injectors from `injectors.py` over a rig from
+`harness.py`, returns an observations dict, and registers at least one
+safety and one liveness invariant.  Smoke scenarios (`smoke=True`) are
+the fast subset tier-1 runs on every push; the rest are the
+`faults`-marked stress tier (`tests/test_scenarios_slow.py`).
+
+Adversary models for the fast-sync scenarios follow the deterministic-
+finality literature: stale finality proofs (PoTE, arXiv:2512.09409) and
+partial-commit replay (ACE, arXiv:2603.10242) — a byzantine block
+server re-presenting yesterday's commit, or a quorum certificate pruned
+below +2/3, for blocks it wants a syncing node to accept.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+from tendermint_tpu.consensus.wal import WAL
+from tendermint_tpu.crypto import backend as cb
+from tendermint_tpu.crypto.backend import PythonBackend
+from tendermint_tpu.crypto.supervised import CLOSED, SupervisedBackend
+from tendermint_tpu.p2p.switch import connect_switches
+from tendermint_tpu.scenarios import fixtures, harness, injectors
+from tendermint_tpu.scenarios import invariants as inv
+from tendermint_tpu.scenarios.engine import register
+from tendermint_tpu.state.evidence import EvidencePool
+from tendermint_tpu.utils import chaos as chaosmod
+from tendermint_tpu.utils.db import MemDB
+from tendermint_tpu.utils.metrics import REGISTRY
+
+
+@contextlib.contextmanager
+def _python_backend():
+    old = cb._current
+    cb.set_backend("python")
+    try:
+        yield
+    finally:
+        cb._current = old
+
+
+# ===========================================================================
+# byz-equivocation (smoke)
+# ===========================================================================
+
+def _byz_equivocation(ctx):
+    chain_id = "chaos-equivocation"
+    target = 4
+    with _python_backend():
+        nodes, _privs, _gen = harness.wire_net(chain_id, 4, seed=1)
+        byz = nodes[0]
+        heights = injectors.plan_heights(ctx, "equivocation",
+                                         1, target + 2, k=3)
+        evidence: list = []
+        ev_lock = threading.Lock()
+        for nd in nodes[1:]:
+            nd.cs.evsw.subscribe(
+                "scenario", "EvidenceDoubleSign",
+                lambda e: (ev_lock.acquire(), evidence.append(e),
+                           ev_lock.release()))
+        injectors.equivocate(ctx, byz, byz.priv, chain_id, heights)
+        for nd in nodes:
+            nd.cs.start()
+        try:
+            nodes[1].mempool.check_tx(b"chaos=equivocation")
+            reached = harness.wait_until(
+                lambda: all(nd.block_store.height >= target
+                            for nd in nodes[1:]), timeout=60)
+            captured = harness.wait_until(lambda: bool(evidence),
+                                          timeout=20)
+        finally:
+            for nd in nodes:
+                nd.cs.stop()
+    with ev_lock:
+        ev_count = len(evidence)
+        ev_ok = all(
+            e.vote_a.validator_address == byz.priv.address
+            and e.vote_a.block_id.key() != e.vote_b.block_id.key()
+            for e in evidence)
+    ctx.note("equivocation.result", evidence=ev_count,
+             heights=[nd.block_store.height for nd in nodes])
+    return {"reached": reached, "captured": captured,
+            "evidence_count": ev_count, "evidence_wellformed": ev_ok,
+            "honest_heights": [nd.block_store.height for nd in nodes[1:]],
+            "_honest_stores": [nd.block_store for nd in nodes[1:]]}
+
+
+def _equiv_safety_agreement(ctx, obs):
+    inv.no_conflicting_commits(obs["_honest_stores"])
+
+
+def _equiv_safety_evidence(ctx, obs):
+    inv.require(obs["captured"] and obs["evidence_count"] >= 1,
+                "honest nodes captured no DuplicateVoteEvidence — the "
+                "double votes were accepted silently")
+    inv.require(obs["evidence_wellformed"],
+                "captured evidence does not accuse the byzantine "
+                "validator with conflicting block ids")
+
+
+def _equiv_liveness(ctx, obs):
+    inv.completed(obs, "reached",
+                  "honest nodes' height progress under equivocation")
+
+
+register(
+    "byz-equivocation",
+    "1 of 4 validators double-signs prevotes at seed-chosen heights; "
+    "honest nodes must keep committing identical blocks and capture "
+    "DuplicateVoteEvidence",
+    safety=[("no-conflicting-commits", _equiv_safety_agreement),
+            ("equivocation-evidenced", _equiv_safety_evidence)],
+    liveness=[("honest-progress", _equiv_liveness)],
+    smoke=True)(_byz_equivocation)
+
+
+# ===========================================================================
+# evidence-flood (smoke)
+# ===========================================================================
+
+def _evidence_flood(ctx):
+    chain_id = "chaos-evflood"
+    with _python_backend():
+        privs, vs = fixtures.make_validators(4, seed=2)
+        pool = EvidencePool(MemDB(), chain_id)
+        real, bogus = injectors.fabricate_evidence(
+            ctx, privs, vs, chain_id, n_real=6, n_bogus=18)
+        # a solo validator keeps committing while the flood lands
+        nodes, _, _ = harness.wire_net(chain_id, 1, seed=3)
+        solo = nodes[0]
+        solo.cs.start()
+        try:
+            h_before = solo.block_store.height
+            salvo = ([("real", e) for e in real]
+                     + [("bogus", e) for e in bogus])
+            ctx.rng("flood-order").shuffle(salvo)
+            accepted = {"real": 0, "bogus": 0}
+            for kind, e in salvo:
+                if pool.add(e, vs):
+                    accepted[kind] += 1
+            flood_done_h = solo.block_store.height
+            progressed = harness.wait_until(
+                lambda: solo.block_store.height >= flood_done_h + 2,
+                timeout=30)
+            h_after = solo.block_store.height
+        finally:
+            solo.cs.stop()
+    ctx.note("flood.result", accepted=accepted, pool_size=pool.size())
+    return {"accepted_real": accepted["real"],
+            "accepted_bogus": accepted["bogus"],
+            "pool_size": pool.size(), "n_real": len(real),
+            "n_bogus": len(bogus), "progressed": progressed,
+            "h_before": h_before, "h_after": h_after}
+
+
+def _flood_safety(ctx, obs):
+    inv.require(obs["accepted_bogus"] == 0,
+                f"pool accepted {obs['accepted_bogus']} fabricated "
+                f"evidence items — forged proofs were silently believed")
+    inv.require(obs["accepted_real"] == obs["n_real"]
+                and obs["pool_size"] == obs["n_real"],
+                f"pool holds {obs['pool_size']} items, expected exactly "
+                f"the {obs['n_real']} real proofs "
+                f"(accepted_real={obs['accepted_real']})")
+
+
+def _flood_liveness(ctx, obs):
+    inv.completed(obs, "progressed",
+                  "solo validator progress during/after evidence flood")
+    inv.height_progressed("solo validator", obs["h_before"],
+                          obs["h_after"], min_delta=2)
+
+
+register(
+    "evidence-flood",
+    "a pool is flooded with fabricated equivocation proofs (strangers, "
+    "agreeing votes, torn signatures) mixed with real ones; only the "
+    "real ones may land, and consensus keeps committing",
+    safety=[("only-valid-evidence", _flood_safety)],
+    liveness=[("commit-progress", _flood_liveness)],
+    smoke=True)(_evidence_flood)
+
+
+# ===========================================================================
+# device-rung-walk (smoke)
+# ===========================================================================
+
+N_RUNGWALK_BLOCKS = 48
+
+
+def _device_rung_walk(ctx):
+    chain_id = "chaos-rungwalk"
+    spec = "raise:every=18"
+    ctx.plan("crypto-chaos", spec=spec)
+    # the programmatic TM_CHAOS_CRYPTO path: install the validated config
+    # and let the supervisor pick it up via CryptoChaos.current()
+    chaosmod.install(chaosmod.ChaosConfig(seed=ctx.seed, crypto=spec))
+    with _python_backend():
+        privs, vs = fixtures.make_validators(4, seed=4)
+        gen = fixtures.make_genesis(chain_id, privs)
+        hashes = fixtures.kvstore_app_hashes(N_RUNGWALK_BLOCKS)
+        chain = fixtures.build_chain(privs, vs, chain_id,
+                                     N_RUNGWALK_BLOCKS, app_hashes=hashes)
+        src_sw, _, src_store = harness.fastsync_source(chain_id, chain, gen)
+        sync_sw, bc, _cons, sync_store = harness.fastsync_syncer(
+            chain_id, gen, batch_size=2)
+        sup = SupervisedBackend(
+            [("dev", PythonBackend()), ("python", PythonBackend())],
+            breaker_threshold=1, breaker_cooldown_s=0.2,
+            retries=0, call_timeout_s=30.0)
+        evicted: list = []
+        orig_evict = bc.pool.on_evict
+        bc.pool.on_evict = lambda p, r: (evicted.append(p),
+                                         orig_evict and orig_evict(p, r))
+        trips0 = REGISTRY.crypto_breaker_trips.value
+        recov0 = REGISTRY.crypto_breaker_recoveries.value
+        old = cb._current
+        cb._current = sup
+        src_sw.start(); sync_sw.start()
+        try:
+            connect_switches(sync_sw, src_sw)
+            deadline = time.time() + 90
+            snapped = False
+            while (sync_store.height < N_RUNGWALK_BLOCKS - 1
+                   and time.time() < deadline):
+                if (REGISTRY.crypto_breaker_trips.value > trips0
+                        and sup.chaos is not None and sup.chaos.active):
+                    # fault storm "clears" after the first trip; from
+                    # here the half-open probe must restore the rung
+                    ctx.snapshot_metrics("faulted")
+                    snapped = True
+                    sup.chaos.active = False
+                    ctx.note("chaos.cleared", mode=sup.chaos.mode)
+                time.sleep(0.02)
+            if not snapped:
+                ctx.snapshot_metrics("faulted")
+            synced = sync_store.height >= N_RUNGWALK_BLOCKS - 1
+            # drive half-open probes until the breaker recovers
+            from tendermint_tpu.crypto import pure_ed25519 as ref
+            seed32 = bytes(32)
+            pub = np.frombuffer(ref.pubkey_from_seed(seed32), np.uint8)
+            msg = np.zeros(32, np.uint8)
+            sig = np.frombuffer(ref.sign(seed32, msg.tobytes()), np.uint8)
+            deadline = time.time() + 10
+            while (REGISTRY.crypto_breaker_recoveries.value == recov0
+                   and time.time() < deadline):
+                sup.verify_batch(pub[None, :], msg[None, :], sig[None, :])
+                time.sleep(0.05)
+            recovered = (REGISTRY.crypto_breaker_recoveries.value > recov0
+                         and sup._rungs[0].state == CLOSED)
+            chain_ok = all(
+                sync_store.load_block(h).hash()
+                == src_store.load_block(h).hash()
+                for h in range(1, min(sync_store.height,
+                                      N_RUNGWALK_BLOCKS - 2) + 1))
+            app_hash_ok = bc.state.app_hash == hashes[-1]
+        finally:
+            src_sw.stop(); sync_sw.stop()
+            cb._current = old
+    status = sup.supervisor_status()
+    ctx.note("rungwalk.result", synced_height=sync_store.height,
+             recovered=recovered, active_rung=status.get("active_rung"),
+             evicted=evicted)
+    return {"synced": synced, "recovered": recovered,
+            "chain_ok": chain_ok, "app_hash_ok": app_hash_ok,
+            "evicted": evicted, "synced_height": sync_store.height}
+
+
+def _rungwalk_safety(ctx, obs):
+    inv.no_silent_acceptance(ctx)
+    inv.require(obs["chain_ok"] and obs["app_hash_ok"],
+                "synced state diverged from the source under device "
+                f"faults (chain_ok={obs['chain_ok']}, "
+                f"app_hash_ok={obs['app_hash_ok']})")
+
+
+def _rungwalk_safety_no_blame(ctx, obs):
+    inv.require(not obs["evicted"],
+                f"peers evicted for OUR injected device faults: "
+                f"{obs['evicted']}")
+
+
+def _rungwalk_liveness(ctx, obs):
+    inv.completed(obs, "synced", "fast-sync under device-fault storm")
+    inv.metric_increased(ctx, "blocks_synced")
+
+
+def _rungwalk_liveness_recovery(ctx, obs):
+    inv.metric_increased(ctx, "crypto_breaker_trips")
+    inv.require(obs["recovered"],
+                "device rung never recovered (breaker stayed open) "
+                "after the fault storm cleared")
+
+
+register(
+    "device-rung-walk",
+    "sustained device faults during fast-sync force supervised-ladder "
+    "demotion; the breaker trips, the sync completes on fallback rungs "
+    "with byte-identical state, and the rung recovers once faults clear",
+    safety=[("no-silent-acceptance", _rungwalk_safety),
+            ("no-peer-blame", _rungwalk_safety_no_blame)],
+    liveness=[("sync-completes", _rungwalk_liveness),
+              ("rung-recovers", _rungwalk_liveness_recovery)],
+    smoke=True)(_device_rung_walk)
+
+
+# ===========================================================================
+# device-wrong-answer (smoke)
+# ===========================================================================
+
+def _device_wrong_answer(ctx):
+    spec = "wrong:lanes=1,every=3"
+    ctx.plan("crypto-chaos", spec=spec)
+    chaosmod.install(chaosmod.ChaosConfig(seed=ctx.seed, crypto=spec))
+    sup = SupervisedBackend(
+        [("dev", PythonBackend()), ("python", PythonBackend())],
+        breaker_threshold=3, breaker_cooldown_s=0.1,
+        retries=0, call_timeout_s=30.0, spot_check_every=1)
+    from tendermint_tpu.crypto import pure_ed25519 as ref
+    rng = ctx.rng("vectors")
+    n_calls = 12
+    ctx.plan("verify-calls", n=n_calls)
+    wrong = 0
+    for i in range(n_calls):
+        seed32 = bytes(rng.randrange(256) for _ in range(32))
+        msg = bytes(rng.randrange(256) for _ in range(32))
+        good = rng.randrange(2) == 0
+        sig = ref.sign(seed32, msg)
+        if not good:
+            sig = sig[:-1] + bytes([sig[-1] ^ 1])
+        pub = np.frombuffer(ref.pubkey_from_seed(seed32), np.uint8)
+        out = sup.verify_batch(pub[None, :],
+                               np.frombuffer(msg, np.uint8)[None, :],
+                               np.frombuffer(sig, np.uint8)[None, :])
+        if bool(out[0]) != good:
+            wrong += 1
+    ctx.snapshot_metrics("faulted")
+    if sup.chaos is not None:
+        sup.chaos.active = False
+    # after the storm clears the device rung must serve clean answers
+    seed32 = bytes(32)
+    msg = bytes(32)
+    sig = ref.sign(seed32, msg)
+    pub = np.frombuffer(ref.pubkey_from_seed(seed32), np.uint8)
+    out = sup.verify_batch(pub[None, :],
+                           np.frombuffer(msg, np.uint8)[None, :],
+                           np.frombuffer(sig, np.uint8)[None, :])
+    cleared_ok = bool(out[0])
+    ctx.note("wrong-answer.result", wrong=wrong, cleared_ok=cleared_ok)
+    return {"wrong_answers": wrong, "n_calls": n_calls,
+            "cleared_ok": cleared_ok}
+
+
+def _wrong_safety(ctx, obs):
+    inv.require(obs["wrong_answers"] == 0,
+                f"{obs['wrong_answers']}/{obs['n_calls']} corrupted "
+                f"verify answers were ACCEPTED — silent signature "
+                f"acceptance")
+    # the chaos really corrupted answers and the spot check caught them
+    inv.metric_increased(ctx, "crypto_spot_check_mismatches",
+                         until="faulted")
+    inv.no_silent_acceptance(ctx)
+
+
+def _wrong_liveness(ctx, obs):
+    inv.completed(obs, "cleared_ok",
+                  "verify service after wrong-answer storm cleared")
+
+
+register(
+    "device-wrong-answer",
+    "a silently-corrupting device flips verify lanes; the per-call spot "
+    "check must catch every corruption (DeviceFault, fallback re-serve) "
+    "so no wrong answer is ever returned",
+    safety=[("no-silent-acceptance", _wrong_safety)],
+    liveness=[("service-after-clear", _wrong_liveness)],
+    smoke=True)(_device_wrong_answer)
+
+
+# ===========================================================================
+# stale-commit-replay / partial-commit-replay (stress)
+# ===========================================================================
+
+N_REPLAY_BLOCKS = 24
+
+
+def _commit_replay_body(ctx, mode: str):
+    chain_id = f"chaos-{mode}-replay"
+    with _python_backend():
+        privs, vs = fixtures.make_validators(4, seed=5)
+        gen = fixtures.make_genesis(chain_id, privs)
+        hashes = fixtures.kvstore_app_hashes(N_REPLAY_BLOCKS)
+        chain = fixtures.build_chain(privs, vs, chain_id, N_REPLAY_BLOCKS,
+                                     app_hashes=hashes)
+        heights = injectors.plan_heights(ctx, f"{mode}-heights",
+                                         3, N_REPLAY_BLOCKS - 2, k=3)
+        byz_sw, _, _ = harness.fastsync_source(chain_id, chain, gen,
+                                               moniker="byz")
+        injectors.tamper_block_server(ctx, byz_sw, chain, mode, heights)
+        honest_sw, _, honest_store = harness.fastsync_source(
+            chain_id, chain, gen, moniker="honest")
+        sync_sw, bc, _cons, sync_store = harness.fastsync_syncer(
+            chain_id, gen, batch_size=4)
+        evicted: list = []
+        orig_evict = bc.pool.on_evict
+        bc.pool.on_evict = lambda p, r: (evicted.append(p),
+                                         orig_evict and orig_evict(p, r))
+        for sw in (byz_sw, honest_sw, sync_sw):
+            sw.start()
+        try:
+            connect_switches(sync_sw, byz_sw)
+            connect_switches(sync_sw, honest_sw)
+            honest_id = honest_sw.node_info.id
+            synced = harness.wait_until(
+                lambda: sync_store.height >= N_REPLAY_BLOCKS - 1,
+                timeout=60)
+            chain_ok = all(
+                sync_store.load_block(h).hash()
+                == honest_store.load_block(h).hash()
+                for h in range(1, min(sync_store.height,
+                                      N_REPLAY_BLOCKS - 2) + 1))
+        finally:
+            for sw in (byz_sw, honest_sw, sync_sw):
+                sw.stop()
+    ctx.note("replay.result", mode=mode, synced_height=sync_store.height,
+             evicted=[p[:12] for p in evicted])
+    return {"synced": synced, "chain_ok": chain_ok,
+            "honest_evicted": honest_id in evicted,
+            "synced_height": sync_store.height,
+            "pool_status": bc.pool.status()}
+
+
+def _replay_safety(ctx, obs):
+    inv.require(obs["chain_ok"],
+                "a replayed commit was accepted: synced chain diverges "
+                "from the honest chain")
+
+
+def _replay_safety_blame(ctx, obs):
+    inv.require(not obs["honest_evicted"],
+                "the honest peer was evicted for the byzantine peer's "
+                "replayed commits")
+
+
+def _replay_liveness(ctx, obs):
+    inv.completed(obs, "synced",
+                  f"fast-sync past replayed commits "
+                  f"(status {obs['pool_status']})")
+
+
+for _mode, _desc in (
+        ("stale", "a byzantine block server splices OLDER seen-commits "
+                  "into served blocks (stale finality proofs, PoTE); "
+                  "the syncer must reject them, evict the liar, and "
+                  "finish byte-identical from the honest peer"),
+        ("partial", "a byzantine block server prunes served LastCommits "
+                    "below +2/3 (partial-commit replay, ACE); same "
+                    "rejection contract, and the honest peer that "
+                    "served the preceding block must not be blamed")):
+    register(
+        f"{_mode}-commit-replay", _desc,
+        safety=[("replayed-commit-rejected", _replay_safety),
+                ("honest-peer-spared", _replay_safety_blame)],
+        liveness=[("sync-completes", _replay_liveness)],
+        smoke=False)(
+            (lambda m: lambda ctx: _commit_replay_body(ctx, m))(_mode))
+
+
+# ===========================================================================
+# partition-heal (stress)
+# ===========================================================================
+
+def _partition_heal(ctx):
+    chain_id = "chaos-partition"
+    window_s = 2.0
+    with _python_backend():
+        nodes, _privs = harness.reactor_net(chain_id, 4, fuzz=True, seed=6)
+        victim_i = ctx.rng("partition").randrange(4)
+        ctx.plan("partition", victim=victim_i, window_s=window_s,
+                 direction="inbound")
+        victim = nodes[victim_i]
+        others = [nd for i, nd in enumerate(nodes) if i != victim_i]
+        try:
+            nodes[0].mempool.check_tx(b"chaos=partition")
+            pre_ok = harness.wait_until(
+                lambda: all(nd.block_store.height >= 2 for nd in nodes),
+                timeout=60)
+            h_victim0 = victim.block_store.height
+            # one-directional: the victim goes deaf (its reads stall) but
+            # keeps speaking — the asymmetric-fuzz partition shape
+            injectors.sever_inbound(ctx, victim.fuzz_links(), stall=1.0,
+                                    label=f"node{victim_i}")
+            time.sleep(window_s)
+            h_others_mid = max(nd.block_store.height for nd in others)
+            injectors.restore(ctx, victim.fuzz_links(),
+                              label=f"node{victim_i}")
+            healed = harness.wait_until(
+                lambda: victim.block_store.height >= h_others_mid + 1,
+                timeout=90)
+            quorum_ok = harness.wait_until(
+                lambda: max(nd.block_store.height
+                            for nd in others) > h_others_mid,
+                timeout=60)
+            h_victim1 = victim.block_store.height
+        finally:
+            for nd in nodes:
+                nd.stop()
+    ctx.note("partition.result", pre_ok=pre_ok, healed=healed,
+             heights=[nd.block_store.height for nd in nodes])
+    return {"pre_ok": pre_ok, "healed": healed, "quorum_ok": quorum_ok,
+            "h_victim_before_heal": h_victim0,
+            "h_victim_after_heal": h_victim1,
+            "_stores": [nd.block_store for nd in nodes]}
+
+
+def _partition_safety(ctx, obs):
+    inv.no_conflicting_commits(obs["_stores"])
+
+
+def _partition_liveness(ctx, obs):
+    inv.completed(obs, "pre_ok", "pre-partition convergence")
+    inv.completed(obs, "quorum_ok",
+                  "quorum progress during/after the partition")
+    inv.completed(obs, "healed", "victim catch-up after heal")
+    inv.height_progressed("partitioned node", obs["h_victim_before_heal"],
+                          obs["h_victim_after_heal"], min_delta=1)
+
+
+register(
+    "partition-heal",
+    "a seed-chosen node is partitioned one-directionally (deaf, still "
+    "speaking) via asymmetric fuzz profiles; the 3-node quorum keeps "
+    "committing, and after heal the victim catches up with no "
+    "conflicting commits",
+    safety=[("no-conflicting-commits", _partition_safety)],
+    liveness=[("heal-and-catch-up", _partition_liveness)],
+    smoke=False)(_partition_heal)
+
+
+# ===========================================================================
+# crash-restart-storm (stress)
+# ===========================================================================
+
+def _crash_restart_storm(ctx):
+    chain_id = "chaos-crashstorm"
+    rng = ctx.rng("crash")
+    deltas = [rng.randrange(2, 5) for _ in range(2)]
+    ctx.plan("crash-schedule", deltas=deltas)
+    home = tempfile.mkdtemp(prefix="chaos-crash-")
+    wal_path = os.path.join(home, "data", "cs.wal")
+    prefix_hashes: dict[int, bytes] = {}
+    stable = True
+    target = 0
+    for cycle, delta in enumerate(deltas):
+        target += delta
+        node = harness.solo_node(home, chain_id)
+        node.start()
+        try:
+            reached = harness.wait_until(
+                lambda: node.block_store.height >= target, timeout=60)
+            if reached:
+                # read the committed prefix while the node is live
+                # (stop() may close the sqlite stores)
+                for h in range(1, target + 1):
+                    bh = node.block_store.load_block(h).hash()
+                    if h in prefix_hashes and prefix_hashes[h] != bh:
+                        stable = False
+                    prefix_hashes[h] = bh
+            height_now = node.block_store.height
+        finally:
+            node.stop()
+        if not reached:
+            ctx.note("crash.stall", cycle=cycle, target=target,
+                     height=height_now)
+            return {"progressed": False, "prefix_stable": stable,
+                    "final_height": height_now, "last_target": target}
+        injectors.tear_wal_tail(ctx, wal_path, rng)
+        ctx.note("crash.cycle", cycle=cycle, height=target)
+    # final restart: must replay past the torn tail and keep going
+    node = harness.solo_node(home, chain_id)
+    node.start()
+    try:
+        progressed = harness.wait_until(
+            lambda: node.block_store.height >= target + 2, timeout=60)
+        final_height = node.block_store.height
+        for h in range(1, target + 1):
+            if prefix_hashes[h] != node.block_store.load_block(h).hash():
+                stable = False
+    finally:
+        node.stop()
+    report = WAL.fsck(wal_path)
+    ctx.note("crash.final", final_height=final_height,
+             fsck_records=report["records"],
+             tail_garbage=bool(report["tail_garbage"]))
+    return {"progressed": progressed, "prefix_stable": stable,
+            "final_height": final_height, "last_target": target,
+            "wal_records": report["records"]}
+
+
+def _crash_safety(ctx, obs):
+    inv.require(obs["prefix_stable"],
+                "a restart rewrote an already-committed block — the "
+                "chain prefix changed across crash cycles")
+
+
+def _crash_liveness(ctx, obs):
+    inv.completed(obs, "progressed",
+                  f"height progress after the crash storm (reached "
+                  f"{obs['final_height']}, needed "
+                  f"{obs['last_target'] + 2})")
+
+
+register(
+    "crash-restart-storm",
+    "SIGKILL-style teardown mid-WAL-write (torn frames appended at "
+    "seed-chosen heights), twice; every restart must replay past the "
+    "torn tail, never rewrite a committed block, and keep committing",
+    safety=[("committed-prefix-stable", _crash_safety)],
+    liveness=[("progress-after-restarts", _crash_liveness)],
+    smoke=False)(_crash_restart_storm)
+
+
+SMOKE_ORDER = ["device-wrong-answer", "evidence-flood",
+               "byz-equivocation", "device-rung-walk"]

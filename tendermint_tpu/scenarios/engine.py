@@ -1,0 +1,310 @@
+"""Seed-deterministic fault-scenario engine.
+
+A *scenario* is a declarative composition of fault injectors (byzantine
+vote streams, network partitions, crash-restart storms, device-fault
+storms) plus a post-mortem: safety and liveness invariants checked
+against flight-recorder and metric evidence after the run.
+
+The replay contract
+-------------------
+Every scenario runs from ONE integer seed.  All injector randomness is
+derived from it through `utils.chaos.derive_seed(seed, *labels)` — the
+per-injector RNGs, the `FuzzedConnection` streams, the crash schedule,
+the byzantine height sets.  The engine keeps an *event log* with two
+streams:
+
+- **plan events** (`ctx.plan(...)`): the injected-fault schedule as
+  derived from the seed — which heights equivocate, which window the
+  partition covers, which chaos spec the crypto ladder gets, which RNG
+  seeds were handed out.  Plan events are a pure function of
+  (scenario, seed): their canonical-JSON sha256 is the *event log
+  hash*, and two runs with the same seed MUST produce the same hash
+  (tier-1 asserts this).
+- **notes** (`ctx.note(...)`): what actually happened at runtime
+  (timing-dependent: observed heights, breaker trips, eviction order).
+  Notes are dumped for triage but never hashed.
+
+Post-mortem + artifacts
+-----------------------
+After the scenario body returns, the engine runs its registered safety
+and liveness invariants.  On ANY failure (body exception or invariant
+violation) it dumps a per-scenario artifact directory:
+
+    <artifacts>/<scenario>-seed<N>/
+        trace.json      flight-recorder Chrome trace (load in Perfetto)
+        metrics.json    phase-labeled REGISTRY snapshots (incl. per-rung
+                        crypto counters)
+        events.json     the event log: plan stream, hash, and notes
+        result.json     manifest: outcome, failures, seed — the replay
+                        input for `cli chaos replay`
+
+Triage flow: read result.json for the failed invariant, open trace.json
+in Perfetto against events.json's plan timeline, then re-run bit-
+identically with `cli chaos run --scenario <name> --seed <N>`.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+
+from tendermint_tpu.utils import chaos as chaosmod
+from tendermint_tpu.utils import tracing
+from tendermint_tpu.utils.log import get_logger
+from tendermint_tpu.utils.metrics import REGISTRY
+
+log = get_logger("scenarios")
+
+# Fixed default seed for the faults tier: CI runs are reproducible by
+# default, and a red run's artifact names tell you the seed to replay.
+DEFAULT_SEED = 20260806
+
+
+class InvariantViolation(AssertionError):
+    """A scenario post-mortem assertion failed.  The message must carry
+    the evidence (heights, hashes, metric values) — it is what lands in
+    result.json for triage."""
+
+
+class EventLog:
+    """Deterministic plan stream + timing-dependent note stream."""
+
+    def __init__(self):
+        self._plan: list[dict] = []
+        self._notes: list[dict] = []
+
+    def plan(self, event: str, **fields) -> None:
+        """Record one planned injection.  Fields must be JSON-safe and
+        derived only from the seed (never wall-clock) — they are hashed
+        into the determinism contract."""
+        self._plan.append({"event": event, **fields})
+
+    def note(self, event: str, **fields) -> None:
+        """Record a runtime observation (not hashed)."""
+        self._notes.append({"t": round(time.time(), 6),
+                            "event": event, **fields})
+
+    def hash(self) -> str:
+        blob = json.dumps(self._plan, sort_keys=True,
+                          separators=(",", ":")).encode()
+        return hashlib.sha256(blob).hexdigest()
+
+    def to_dict(self) -> dict:
+        return {"hash": self.hash(), "plan": list(self._plan),
+                "notes": list(self._notes)}
+
+
+class ScenarioContext:
+    """What a scenario body (and its injectors) gets to work with."""
+
+    def __init__(self, scenario: "Scenario", seed: int):
+        self.scenario = scenario
+        self.seed = seed
+        self.log = EventLog()
+        self.recorder = tracing.RECORDER
+        self.metric_phases: list[dict] = []
+        self._rngs: dict[str, object] = {}
+
+    # -- derived randomness ---------------------------------------------
+    def derive_seed(self, *labels: str) -> int:
+        return chaosmod.derive_seed(self.seed, self.scenario.name, *labels)
+
+    def rng(self, name: str):
+        """A named `random.Random` derived from the scenario seed; the
+        derivation is logged as a plan event so the seed handed to each
+        injector is part of the hashed schedule."""
+        if name not in self._rngs:
+            import random
+            child = self.derive_seed("rng", name)
+            self.log.plan("rng", name=name, seed=child)
+            self._rngs[name] = random.Random(child)
+        return self._rngs[name]
+
+    # -- event log shorthands -------------------------------------------
+    def plan(self, event: str, **fields) -> None:
+        self.log.plan(event, **fields)
+
+    def note(self, event: str, **fields) -> None:
+        self.log.note(event, **fields)
+
+    # -- evidence capture ------------------------------------------------
+    def snapshot_metrics(self, phase: str) -> dict:
+        """Capture a phase-labeled REGISTRY snapshot (includes the
+        rung-labeled crypto counters) — the metric evidence invariants
+        assert against."""
+        snap = {"phase": phase, "metrics": REGISTRY.snapshot()}
+        self.metric_phases.append(snap)
+        self.recorder.instant("scenario.phase", phase=phase)
+        return snap
+
+    def metrics(self, phase: str) -> dict | None:
+        for snap in self.metric_phases:
+            if snap["phase"] == phase:
+                return snap["metrics"]
+        return None
+
+
+class Scenario:
+    """A registered scenario: body + named safety/liveness invariants.
+
+    `body(ctx)` composes injectors and returns a JSON-safe observations
+    dict; each invariant is `(name, fn)` with `fn(ctx, obs)` raising
+    InvariantViolation on failure.  Every shipped scenario must carry at
+    least one safety AND one liveness invariant — registration enforces
+    it so a scenario cannot silently ship without a post-mortem."""
+
+    def __init__(self, name: str, description: str, body,
+                 safety: list, liveness: list, smoke: bool = False):
+        if not safety or not liveness:
+            raise ValueError(
+                f"scenario {name!r} needs >=1 safety and >=1 liveness "
+                f"invariant (got {len(safety)}/{len(liveness)})")
+        self.name = name
+        self.description = description
+        self.body = body
+        self.safety = list(safety)
+        self.liveness = list(liveness)
+        self.smoke = smoke
+
+
+SCENARIOS: dict[str, Scenario] = {}
+
+
+def register(name: str, description: str, safety: list, liveness: list,
+             smoke: bool = False):
+    """Decorator: `@register("byz-equivocation", "...", safety=[...],
+    liveness=[...])` over the scenario body."""
+    def deco(fn):
+        if name in SCENARIOS:
+            raise ValueError(f"duplicate scenario {name!r}")
+        SCENARIOS[name] = Scenario(name, description, fn,
+                                   safety, liveness, smoke=smoke)
+        return fn
+    return deco
+
+
+class ScenarioResult:
+    def __init__(self, name: str, seed: int, ok: bool, failures: list[str],
+                 event_log_hash: str, duration_s: float,
+                 observations: dict, artifact_dir: str | None):
+        self.name = name
+        self.seed = seed
+        self.ok = ok
+        self.failures = failures
+        self.event_log_hash = event_log_hash
+        self.duration_s = duration_s
+        self.observations = observations
+        self.artifact_dir = artifact_dir
+
+    def to_dict(self) -> dict:
+        return {"scenario": self.name, "seed": self.seed, "ok": self.ok,
+                "failures": self.failures,
+                "event_log_hash": self.event_log_hash,
+                "duration_s": round(self.duration_s, 3),
+                "observations": _json_safe(self.observations),
+                "artifact_dir": self.artifact_dir}
+
+
+def _json_safe(obj):
+    """Coerce observation values for the manifest: bytes become hex,
+    unknown objects their repr — a dump must never fail the dumper."""
+    if isinstance(obj, dict):
+        return {str(k): _json_safe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_json_safe(v) for v in obj]
+    if isinstance(obj, (bytes, bytearray)):
+        return obj.hex()
+    if isinstance(obj, (str, int, float, bool)) or obj is None:
+        return obj
+    return repr(obj)
+
+
+def artifacts_root(override: str | None = None) -> str:
+    return (override or os.environ.get("TM_SCENARIO_ARTIFACTS")
+            or os.path.join(os.getcwd(), "chaos_artifacts"))
+
+
+def _dump_artifacts(ctx: ScenarioContext, result: ScenarioResult,
+                    root: str) -> str:
+    d = os.path.join(root, f"{ctx.scenario.name}-seed{ctx.seed}")
+    os.makedirs(d, exist_ok=True)
+    ctx.recorder.dump(os.path.join(d, "trace.json"))
+    for fname, payload in (
+            ("metrics.json", ctx.metric_phases),
+            ("events.json", ctx.log.to_dict()),
+            ("result.json", result.to_dict())):
+        tmp = os.path.join(d, fname + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump(_json_safe(payload), f, indent=1)
+        os.replace(tmp, os.path.join(d, fname))
+    return d
+
+
+def run_scenario(name: str, seed: int = DEFAULT_SEED,
+                 artifacts: str | None = None,
+                 keep_artifacts: bool = False) -> ScenarioResult:
+    """Run one registered scenario end to end: install the ChaosConfig,
+    execute the body, snapshot metrics, run the safety+liveness
+    post-mortem, and dump artifacts on failure (always, when
+    `keep_artifacts`).  Never raises on scenario failure — the result
+    carries the verdict; raises only on unknown scenario names."""
+    sc = SCENARIOS.get(name)
+    if sc is None:
+        raise KeyError(f"unknown scenario {name!r}; "
+                       f"known: {sorted(SCENARIOS)}")
+    ctx = ScenarioContext(sc, seed)
+    ctx.plan("scenario", name=name, seed=seed)
+    prev_cfg = chaosmod.install(chaosmod.ChaosConfig(seed=seed))
+    failures: list[str] = []
+    obs: dict = {}
+    t0 = time.perf_counter()
+    ctx.snapshot_metrics("start")
+    try:
+        with ctx.recorder.span("scenario.run", cat=tracing.CAT_NONE,
+                               scenario=name, seed=seed):
+            try:
+                obs = sc.body(ctx) or {}
+            except InvariantViolation as e:
+                failures.append(f"body: {e}")
+            except Exception as e:  # noqa: BLE001 - the post-mortem must
+                # still run and the trace must still dump on ANY failure
+                log.error("scenario body crashed", scenario=name,
+                          error=f"{type(e).__name__}: {e}")
+                failures.append(f"body: {type(e).__name__}: {e}")
+        ctx.snapshot_metrics("end")
+        for kind, invariants in (("safety", sc.safety),
+                                 ("liveness", sc.liveness)):
+            for inv_name, fn in invariants:
+                try:
+                    fn(ctx, obs)
+                    ctx.note("invariant", name=inv_name, kind=kind,
+                             ok=True)
+                except AssertionError as e:
+                    failures.append(f"{kind}:{inv_name}: {e}")
+                    ctx.note("invariant", name=inv_name, kind=kind,
+                             ok=False, error=str(e))
+                except Exception as e:  # noqa: BLE001 - an invariant that
+                    # crashes is a failed invariant, not a passed one
+                    failures.append(
+                        f"{kind}:{inv_name}: {type(e).__name__}: {e}")
+                    ctx.note("invariant", name=inv_name, kind=kind,
+                             ok=False, error=f"{type(e).__name__}: {e}")
+    finally:
+        chaosmod.install(prev_cfg)
+    result = ScenarioResult(
+        name=name, seed=seed, ok=not failures, failures=failures,
+        event_log_hash=ctx.log.hash(),
+        duration_s=time.perf_counter() - t0,
+        observations=obs, artifact_dir=None)
+    if failures or keep_artifacts:
+        try:
+            result.artifact_dir = _dump_artifacts(
+                ctx, result, artifacts_root(artifacts))
+            log.info("scenario artifacts dumped", scenario=name,
+                     dir=result.artifact_dir)
+        except OSError as e:
+            log.error("scenario artifact dump failed", scenario=name,
+                      error=str(e))
+    return result

@@ -1,0 +1,213 @@
+"""In-process node harnesses the scenario catalog composes.
+
+Three rigs, in increasing realism (mirroring the tiers the test suite
+grew organically in `tests/test_consensus.py` / `test_fastsync.py` /
+`test_reactor.py` / `test_wal_corruption.py`):
+
+- `wire_net`: N ConsensusStates delivering broadcasts directly to each
+  other's feed methods — no transport; the fastest rig for byzantine
+  vote-stream scenarios.
+- `fastsync_source` / `fastsync_syncer`: real switches + blockchain
+  reactors over in-memory pairs; the rig for lying/stale/partial-commit
+  peers and device-fault storms during sync.
+- `reactor_net`: full consensus+mempool reactors over switches with
+  FuzzedConnection wrappers in the conn stack, so partition/delay-storm
+  injectors can flip fuzz profiles on live links.
+- `solo_node`: a real sqlite-backed Node (WAL on disk) for
+  crash-restart storms.
+"""
+
+from __future__ import annotations
+
+import time
+
+from tendermint_tpu.blockchain.reactor import BlockchainReactor
+from tendermint_tpu.blockchain.store import BlockStore
+from tendermint_tpu.config import Config, test_config
+from tendermint_tpu.consensus import messages as M
+from tendermint_tpu.consensus.reactor import ConsensusReactor
+from tendermint_tpu.consensus.state import ConsensusState
+from tendermint_tpu.mempool.mempool import Mempool
+from tendermint_tpu.mempool.reactor import MempoolReactor
+from tendermint_tpu.p2p.fuzz import FuzzedConnection
+from tendermint_tpu.p2p.switch import connect_switches, make_switch
+from tendermint_tpu.proxy import ClientCreator
+from tendermint_tpu.scenarios import fixtures
+from tendermint_tpu.state import execution
+from tendermint_tpu.state.state import get_state
+from tendermint_tpu.utils.db import MemDB
+
+
+def wait_until(pred, timeout: float, poll: float = 0.02) -> bool:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(poll)
+    return bool(pred())
+
+
+# -- wire net (no transport) ------------------------------------------------
+
+class WireNode:
+    """ConsensusState + mempool + store, broadcast_cb-wired."""
+
+    def __init__(self, priv, gen, cfg: Config | None = None,
+                 app: str = "kvstore", wal_path: str = ""):
+        cfg = cfg or test_config()
+        self.priv = priv
+        st = get_state(MemDB(), gen)
+        self.conns = ClientCreator(app).new_app_conns()
+        self.mempool = Mempool(self.conns.mempool)
+        self.block_store = BlockStore(MemDB())
+        self.cs = ConsensusState(cfg.consensus, st, self.conns.consensus,
+                                 self.block_store, self.mempool,
+                                 priv_validator=priv, wal_path=wal_path)
+
+
+def wire_net(chain_id: str, n: int, app: str = "kvstore",
+             seed: int = 0) -> tuple[list[WireNode], list, object]:
+    """N validators wired directly: every broadcast lands in every other
+    node's feed methods.  Returns (nodes, privs, genesis)."""
+    privs, _vs = fixtures.make_validators(n, seed=seed)
+    gen = fixtures.make_genesis(chain_id, privs)
+    nodes = [WireNode(p, gen, app=app) for p in privs]
+
+    def make_cb(me: WireNode):
+        def cb(msg):
+            for other in nodes:
+                if other is me:
+                    continue
+                if isinstance(msg, M.VoteMessage):
+                    other.cs.add_vote(msg.vote, peer_id="net")
+                elif isinstance(msg, M.ProposalMessage):
+                    other.cs.set_proposal(msg.proposal, peer_id="net")
+                elif isinstance(msg, M.BlockPartMessage):
+                    other.cs.add_proposal_block_part(
+                        msg.height, msg.round, msg.part, peer_id="net")
+        return cb
+
+    for nd in nodes:
+        nd.cs.broadcast_cb = make_cb(nd)
+    return nodes, privs, gen
+
+
+# -- fast-sync rig ----------------------------------------------------------
+
+def fastsync_source(chain_id: str, chain, gen, moniker: str = "source"):
+    """A served chain: store + state advanced to the tip, behind a
+    switch.  Returns (switch, state, store)."""
+    state = get_state(MemDB(), gen)
+    conns = ClientCreator("kvstore").new_app_conns()
+    store = BlockStore(MemDB())
+    for block, ps, seen in chain:
+        store.save_block(block, ps, seen)
+        execution.apply_block(state, None, conns.consensus, block,
+                              ps.header, execution.MockMempool(),
+                              check_last_commit=False)
+    reactor = BlockchainReactor(state, conns.consensus, store,
+                                fast_sync=False)
+    sw = make_switch(chain_id, {"blockchain": reactor}, moniker=moniker)
+    return sw, state, store
+
+
+def fastsync_syncer(chain_id: str, gen, batch_size: int = 8):
+    """A fresh syncing node.  Returns (switch, bc_reactor, cons_reactor,
+    store)."""
+    state = get_state(MemDB(), gen)
+    conns = ClientCreator("kvstore").new_app_conns()
+    store = BlockStore(MemDB())
+    mp = Mempool(conns.mempool)
+    cs = ConsensusState(test_config().consensus, state.copy(),
+                        conns.consensus, store, mp)
+    cons_reactor = ConsensusReactor(cs, fast_sync=True)
+    bc_reactor = BlockchainReactor(state, conns.consensus, store,
+                                   fast_sync=True, batch_size=batch_size)
+    bc_reactor.on_caught_up = cons_reactor.switch_to_consensus
+    sw = make_switch(chain_id, {"blockchain": bc_reactor,
+                                "consensus": cons_reactor},
+                     moniker="syncer")
+    return sw, bc_reactor, cons_reactor, store
+
+
+# -- reactor net (real p2p, fuzz wrappers in the stack) ---------------------
+
+class ReactorNode:
+    """Consensus core + reactors + switch (the gossip-only rig)."""
+
+    def __init__(self, priv, gen, chain_id: str, moniker: str,
+                 cfg: Config | None = None, fuzz: bool = False):
+        cfg = cfg or test_config()
+        cfg.p2p.laddr = ""        # in-memory pairs only, no TCP listener
+        if fuzz:
+            # wrappers with zero probabilities: inert until an injector
+            # flips a profile (partition/delay storm)
+            cfg.p2p.fuzz = True
+            cfg.p2p.fuzz_drop_prob = 0.0
+            cfg.p2p.fuzz_delay_prob = 0.0
+        st = get_state(MemDB(), gen)
+        self.conns = ClientCreator("kvstore").new_app_conns()
+        self.mempool = Mempool(self.conns.mempool)
+        self.block_store = BlockStore(MemDB())
+        self.cs = ConsensusState(cfg.consensus, st, self.conns.consensus,
+                                 self.block_store, self.mempool,
+                                 priv_validator=priv)
+        self.cons_reactor = ConsensusReactor(self.cs)
+        self.mp_reactor = MempoolReactor(self.mempool)
+        self.switch = make_switch(chain_id, {
+            "consensus": self.cons_reactor,
+            "mempool": self.mp_reactor,
+        }, config=cfg.p2p, moniker=moniker)
+
+    def fuzz_links(self) -> list[FuzzedConnection]:
+        """The FuzzedConnection wrapper of every live peer link on this
+        node's side (empty when fuzz=False)."""
+        out = []
+        for peer in self.switch.peers():
+            sec = peer.mconn.conn
+            inner = getattr(sec, "_conn", None)
+            if isinstance(inner, FuzzedConnection):
+                out.append(inner)
+        return out
+
+    def start(self):
+        self.switch.start()
+
+    def stop(self):
+        self.switch.stop()
+
+
+def reactor_net(chain_id: str, n: int, fuzz: bool = False,
+                seed: int = 0) -> tuple[list[ReactorNode], list]:
+    privs, _vs = fixtures.make_validators(n, seed=seed)
+    gen = fixtures.make_genesis(chain_id, privs)
+    nodes = [ReactorNode(privs[i], gen, chain_id, f"node{i}", fuzz=fuzz)
+             for i in range(n)]
+    for nd in nodes:
+        nd.start()
+    for i in range(n):
+        for j in range(i + 1, n):
+            connect_switches(nodes[i].switch, nodes[j].switch)
+    return nodes, privs
+
+
+# -- full node (sqlite home, WAL on disk) -----------------------------------
+
+def solo_node(home: str, chain_id: str, pv_key_byte: int = 0x31):
+    """A real single-validator Node over a sqlite home dir — the rig for
+    crash-restart storms (its consensus WAL lives on disk at
+    <home>/data/cs.wal).  Rebuilding with the same args after a crash is
+    the restart."""
+    from tendermint_tpu.node.node import Node
+    from tendermint_tpu.types import (GenesisDoc, GenesisValidator, PrivKey,
+                                      PrivValidator)
+    cfg = test_config()
+    cfg.base.home = home
+    cfg.base.db_backend = "sqlite"
+    cfg.rpc.laddr = ""
+    cfg.p2p.laddr = ""
+    pv = PrivValidator(PrivKey(bytes([pv_key_byte]) * 32))
+    gen = GenesisDoc(chain_id=chain_id,
+                     validators=[GenesisValidator(pv.pub_key.bytes_, 10)],
+                     genesis_time_ns=1)
+    return Node(cfg, priv_validator=pv, genesis_doc=gen)

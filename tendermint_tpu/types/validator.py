@@ -51,6 +51,21 @@ class CommitPowerError(ValueError):
         self.foreign_votes = foreign_votes
 
 
+class CommitFormatError(ValueError):
+    """A commit is structurally unusable as the +2/3 proof for `height`:
+    wrong height (a STALE finality proof replayed from an older block),
+    wrong size, or malformed votes.  Like a pruned commit it rides in the
+    successor block's LastCommit, so height+1's deliverer is at fault —
+    without this mapping a replayed stale commit would raise a bare
+    ValueError that fast-sync can only log, stalling the pool forever
+    instead of evicting the liar."""
+
+    def __init__(self, height: int, detail: str):
+        super().__init__(
+            f"unusable commit for height {height}: {detail}")
+        self.height = height
+
+
 @dataclass
 class Validator:
     pub_key: PubKey
@@ -503,8 +518,14 @@ def verify_commits_batched(val_set: ValidatorSet, chain_id: str,
     from tendermint_tpu.crypto import backend as cb
     if not items:
         return
-    arrays = [val_set.commit_verify_lanes(chain_id, bid, h, c)
-              for bid, h, c in items]
+    arrays = []
+    for bid, h, c in items:
+        try:
+            arrays.append(val_set.commit_verify_lanes(chain_id, bid, h, c))
+        except ValueError as e:
+            # stale/malformed commit: surface the height so the caller
+            # can blame the successor's deliverer (see CommitFormatError)
+            raise CommitFormatError(h, str(e)) from None
     counts = [len(a[4]) for a in arrays]
     templates, tmpl_idx, sigs, idxs = merge_commit_lanes(arrays)
     ok = cb.verify_grouped_templated(val_set.set_key(),

@@ -20,10 +20,19 @@ Spec grammar (one mode, comma-separated k=v params):
 the call counter, so a given spec produces the identical fault sequence
 on every run — lossy-device regressions replay exactly, the same promise
 `FuzzedConnection(seed=...)` makes for lossy networks.
+
+This module is also the single home of chaos CONFIGURATION: the
+scenario engine (`tendermint_tpu/scenarios/`) installs a validated
+`ChaosConfig` programmatically via `install()`, and every consumer that
+used to read raw env strings (`SupervisedBackend` -> TM_CHAOS_CRYPTO,
+`FuzzedConnection` seeding) asks this module first.  Env vars remain
+the standalone-node path; an installed config always wins, so a
+scenario never depends on process-global environment mutation.
 """
 
 from __future__ import annotations
 
+import hashlib
 import os
 import threading
 import time
@@ -84,6 +93,16 @@ class CryptoChaos:
         spec = os.environ.get("TM_CHAOS_CRYPTO", "")
         return cls.parse(spec) if spec else None
 
+    @classmethod
+    def current(cls) -> "CryptoChaos | None":
+        """The crypto-chaos policy in effect: the installed ChaosConfig's
+        (scenario engine, programmatic) when one is present, else the
+        TM_CHAOS_CRYPTO env spec (standalone node)."""
+        cfg = installed()
+        if cfg is not None:
+            return cfg.crypto
+        return cls.from_env()
+
     def _fire(self) -> bool:
         """Advance the counter; True when this call is selected."""
         if not self.active:
@@ -119,3 +138,76 @@ class CryptoChaos:
         k = min(self.lanes, len(out))
         out[:k] = ~out[:k]
         return out
+
+
+# ---------------------------------------------------------------------------
+# seed derivation + the installed chaos configuration
+# ---------------------------------------------------------------------------
+
+def derive_seed(seed: int, *labels: str) -> int:
+    """A child seed for the injector named by `labels`, as a pure
+    function of the master seed: sha256 over "seed/label/label/...".
+    Independent injectors get decorrelated streams, and the whole tree
+    replays from the one integer the scenario was launched with."""
+    key = "/".join((str(int(seed)),) + tuple(labels))
+    return int.from_bytes(hashlib.sha256(key.encode()).digest()[:8], "big")
+
+
+class ChaosConfig:
+    """The single validated chaos-configuration object.
+
+    One instance describes everything a fault scenario injects below
+    the scenario engine's own line of sight:
+
+      seed    master integer seed; every injector RNG (FuzzedConnection,
+              byzantine vote schedules, crash schedules) derives from it
+              via `derive_seed`, so one integer replays the whole run
+      crypto  device-fault policy for the supervised crypto ladder —
+              a CryptoChaos, a spec string ("raise:every=50", validated
+              here, at construction), or None for no injection
+
+    Install with `install(cfg)`; consumers read `installed()` (or the
+    `CryptoChaos.current()` convenience).  The env-var path
+    (TM_CHAOS_CRYPTO / TM_CHAOS_SEED via `from_env`) builds the same
+    object, so there is exactly one parse/validation site either way.
+    """
+
+    def __init__(self, seed: int = 0,
+                 crypto: "CryptoChaos | str | None" = None):
+        if isinstance(seed, bool) or not isinstance(seed, int):
+            raise ValueError(f"chaos seed must be an int, got {seed!r}")
+        if isinstance(crypto, str):
+            crypto = CryptoChaos.parse(crypto) if crypto else None
+        if crypto is not None and not isinstance(crypto, CryptoChaos):
+            raise ValueError("chaos crypto= must be a CryptoChaos, a "
+                             f"spec string, or None; got {crypto!r}")
+        self.seed = seed
+        self.crypto = crypto
+
+    @classmethod
+    def from_env(cls) -> "ChaosConfig":
+        return cls(seed=int(os.environ.get("TM_CHAOS_SEED", "0") or 0),
+                   crypto=CryptoChaos.from_env())
+
+    def derive_seed(self, *labels: str) -> int:
+        return derive_seed(self.seed, *labels)
+
+
+_installed: "ChaosConfig | None" = None
+_installed_lock = threading.Lock()
+
+
+def install(cfg: "ChaosConfig | None") -> "ChaosConfig | None":
+    """Set (or with None, clear) the process-wide chaos config; returns
+    the previous one so scenario runners can restore it in a finally."""
+    global _installed
+    if cfg is not None and not isinstance(cfg, ChaosConfig):
+        raise ValueError(f"install() takes a ChaosConfig, got {cfg!r}")
+    with _installed_lock:
+        prev, _installed = _installed, cfg
+    return prev
+
+
+def installed() -> "ChaosConfig | None":
+    with _installed_lock:
+        return _installed

@@ -1,112 +1,12 @@
 """Test helper: deterministic chain construction with real signatures.
 
-The analog of the reference's validatorStub fixtures
-(`consensus/common_test.go:48-106`): N priv-validators produce a valid
-chain of blocks with proper commits, usable by execution, fast-sync,
-replay, and bench code.
+The implementation moved to `tendermint_tpu/scenarios/fixtures.py` so
+the fault-scenario engine (and `cli chaos`) can build chains outside
+pytest; this module stays as the test suite's import point.
 """
 
 from __future__ import annotations
 
-from tendermint_tpu.types import (Block, BlockID, Commit, EMPTY_COMMIT,
-                                  GenesisDoc, GenesisValidator, PrivKey,
-                                  PrivValidator, TYPE_PRECOMMIT, Validator,
-                                  ValidatorSet, Vote, VoteSet, ZERO_BLOCK_ID)
-from tendermint_tpu.types.part_set import PART_SIZE as _PROD_PART_SIZE
-
-# the production part size: fast-sync re-chunks blocks with the default,
-# so fixture commits must sign the same parts header it will recompute
-PART_SIZE = _PROD_PART_SIZE
-
-
-def make_validators(n: int, power: int = 10, seed: int = 0):
-    """Deterministic keys so fixtures are reproducible."""
-    privs = [PrivValidator(PrivKey(bytes([seed + 1, i + 1]) + b"\x00" * 30))
-             for i in range(n)]
-    vs = ValidatorSet([Validator(p.pub_key, power) for p in privs])
-    privs.sort(key=lambda p: p.address)
-    return privs, vs
-
-
-def make_genesis(chain_id: str, privs, power: int = 10) -> GenesisDoc:
-    return GenesisDoc(
-        chain_id=chain_id,
-        validators=[GenesisValidator(p.pub_key.bytes_, power)
-                    for p in privs],
-        genesis_time_ns=1_000_000_000)
-
-
-def sign_vote(priv: PrivValidator, vs: ValidatorSet, chain_id: str,
-              height: int, round_: int, type_: int, block_id) -> Vote:
-    idx = vs.index_of(priv.address)
-    v = Vote(validator_address=priv.address, validator_index=idx,
-             height=height, round=round_, type=type_, block_id=block_id)
-    return Vote(**{**v.__dict__,
-                   "signature": priv.sign_vote(chain_id, v)})
-
-
-def make_commit(privs, vs: ValidatorSet, chain_id: str, height: int,
-                block_id, round_: int = 0) -> Commit:
-    # sign across validators in parallel (independent keys, native signing
-    # releases the GIL) — big bench chains need hundreds of thousands of
-    # votes; accounting stays sequential
-    votes = list(_sign_pool().map(
-        lambda p: sign_vote(p, vs, chain_id, height, round_,
-                            TYPE_PRECOMMIT, block_id), privs))
-    vset = VoteSet(chain_id, height, round_, TYPE_PRECOMMIT, vs)
-    for v in votes:
-        vset.add_vote(v)
-    return vset.make_commit()
-
-
-_pool = None
-
-
-def _sign_pool():
-    global _pool
-    if _pool is None:
-        from concurrent.futures import ThreadPoolExecutor
-        _pool = ThreadPoolExecutor(8)
-    return _pool
-
-
-def kvstore_app_hashes(n: int, txs_per_block: int = 2) -> list[bytes]:
-    """App hashes for a kvstore app fed build_chain's deterministic txs:
-    entry i is the hash going INTO block i+1."""
-    from tendermint_tpu.abci.app import create_app
-    app = create_app("kvstore")
-    hashes = [b""]
-    for h in range(1, n + 1):
-        for i in range(txs_per_block):
-            app.deliver_tx(b"tx-%d-%d" % (h, i))
-        hashes.append(app.commit().data)
-    return hashes[:-1]
-
-
-def build_chain(privs, vs: ValidatorSet, chain_id: str, n_blocks: int,
-                txs_per_block: int = 2, app_hashes: list[bytes] | None = None,
-                part_size: int = PART_SIZE):
-    """Returns [(block, part_set, seen_commit)] for heights 1..n.
-
-    app_hashes[i] is the app hash *going into* block i+1 (i.e. after block
-    i executed); defaults to empty (nilapp semantics).
-    """
-    out = []
-    last_commit = EMPTY_COMMIT
-    last_block_id = ZERO_BLOCK_ID
-    vals_hash = vs.hash()
-    for h in range(1, n_blocks + 1):
-        app_hash = (app_hashes[h - 1] if app_hashes else b"")
-        txs = [b"tx-%d-%d" % (h, i) for i in range(txs_per_block)]
-        block = Block.make(chain_id=chain_id, height=h,
-                           time_ns=1_000_000_000 + h, txs=txs,
-                           last_commit=last_commit,
-                           last_block_id=last_block_id,
-                           validators_hash=vals_hash, app_hash=app_hash)
-        ps = block.make_part_set(part_size)
-        block_id = BlockID(block.hash(), ps.header)
-        seen = make_commit(privs, vs, chain_id, h, block_id)
-        out.append((block, ps, seen))
-        last_commit = seen
-        last_block_id = block_id
-    return out
+from tendermint_tpu.scenarios.fixtures import (  # noqa: F401
+    PART_SIZE, build_chain, kvstore_app_hashes, make_commit, make_genesis,
+    make_validators, sign_vote)

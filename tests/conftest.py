@@ -26,6 +26,39 @@ jax.config.update("jax_compilation_cache_dir", _cache_dir)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    outcome = yield
+    rep = outcome.get_result()
+    setattr(item, f"rep_{rep.when}", rep)
+
+
+@pytest.fixture(autouse=True)
+def _flight_recorder_postmortem(request):
+    """Post-mortem artifacts for the faults tier: when a `faults`-marked
+    test FAILS, dump the flight-recorder Chrome trace and a rung-labeled
+    metric snapshot to the scenario artifact dir (same layout and triage
+    flow as `cli chaos run`; see README "Failure scenarios")."""
+    yield
+    rep = getattr(request.node, "rep_call", None)
+    if rep is None or not rep.failed:
+        return
+    if request.node.get_closest_marker("faults") is None:
+        return
+    import json
+    import re
+    from tendermint_tpu.scenarios.engine import artifacts_root
+    from tendermint_tpu.utils import tracing
+    from tendermint_tpu.utils.metrics import REGISTRY
+    safe = re.sub(r"[^A-Za-z0-9_.-]+", "_", request.node.nodeid)[-80:]
+    d = os.path.join(artifacts_root(None), f"pytest-{safe}")
+    os.makedirs(d, exist_ok=True)
+    tracing.RECORDER.dump(os.path.join(d, "trace.json"))
+    with open(os.path.join(d, "metrics.json"), "w") as f:
+        json.dump(REGISTRY.snapshot(), f, indent=1)
+    print(f"\n[faults post-mortem] trace + metrics dumped to {d}")
+
+
 @pytest.fixture(autouse=True)
 def _isolate_table_disk_cache(tmp_path, monkeypatch):
     """Every test gets a private comb-table disk cache: without this,

@@ -1,0 +1,163 @@
+"""Scenario engine contract + the fast smoke subset (tier-1).
+
+Three things are pinned here:
+
+- the seed-replay contract: same (scenario, seed) -> same event-log
+  hash; different seed -> different injected-fault schedule
+- the engine's guarantees: registration refuses scenarios without a
+  post-mortem, failures dump the full artifact bundle
+- the smoke scenarios themselves, plus their `cli chaos` entry points
+"""
+
+import json
+import os
+
+import pytest
+
+from tendermint_tpu.scenarios import (SCENARIOS, SMOKE_ORDER,
+                                      InvariantViolation, register,
+                                      run_scenario)
+
+pytestmark = pytest.mark.faults
+
+SEED = 11
+
+
+def test_event_log_hash_is_seed_deterministic():
+    """The acceptance criterion: two runs with the same seed inject the
+    exact same fault schedule (bit-identical event-log hash); a
+    different seed derives a different schedule."""
+    a = run_scenario("device-wrong-answer", seed=SEED)
+    b = run_scenario("device-wrong-answer", seed=SEED)
+    c = run_scenario("device-wrong-answer", seed=SEED + 1)
+    assert a.ok, a.failures
+    assert a.event_log_hash == b.event_log_hash
+    assert a.event_log_hash != c.event_log_hash
+
+
+def test_registration_requires_safety_and_liveness():
+    """A scenario cannot ship without a post-mortem: registration
+    enforces >=1 safety AND >=1 liveness invariant."""
+    inv = ("x", lambda ctx, obs: None)
+    with pytest.raises(ValueError, match="safety"):
+        register("_toy-no-liveness", "d", safety=[inv],
+                 liveness=[])(lambda ctx: {})
+    with pytest.raises(ValueError, match="safety"):
+        register("_toy-no-safety", "d", safety=[],
+                 liveness=[inv])(lambda ctx: {})
+    assert "_toy-no-liveness" not in SCENARIOS
+    assert "_toy-no-safety" not in SCENARIOS
+    with pytest.raises(ValueError, match="duplicate"):
+        register("byz-equivocation", "d", safety=[inv],
+                 liveness=[inv])(lambda ctx: {})
+
+
+def test_unknown_scenario_raises():
+    with pytest.raises(KeyError, match="unknown scenario"):
+        run_scenario("no-such-scenario")
+
+
+def test_failure_dumps_artifact_bundle(tmp_path):
+    """Any invariant failure must leave the full triage bundle:
+    trace.json + metrics.json + events.json + result.json, with the
+    failure text in the manifest."""
+    def body(ctx):
+        ctx.plan("toy", x=1)
+        ctx.note("toy.ran")
+        return {"fine": True}
+
+    def bad_safety(ctx, obs):
+        raise InvariantViolation("toy safety evidence: x != y")
+
+    register("_toy-failing", "always fails",
+             safety=[("toy-safety", bad_safety)],
+             liveness=[("toy-liveness", lambda ctx, obs: None)])(body)
+    try:
+        r = run_scenario("_toy-failing", seed=3, artifacts=str(tmp_path))
+    finally:
+        SCENARIOS.pop("_toy-failing", None)
+    assert not r.ok
+    assert any("toy safety evidence" in f for f in r.failures)
+    assert r.artifact_dir == str(tmp_path / "_toy-failing-seed3")
+    for fname in ("trace.json", "metrics.json", "events.json",
+                  "result.json"):
+        assert os.path.exists(os.path.join(r.artifact_dir, fname)), fname
+    with open(os.path.join(r.artifact_dir, "result.json")) as f:
+        manifest = json.load(f)
+    assert manifest["scenario"] == "_toy-failing"
+    assert manifest["seed"] == 3
+    assert manifest["event_log_hash"] == r.event_log_hash
+    assert any("toy safety evidence" in f for f in manifest["failures"])
+    with open(os.path.join(r.artifact_dir, "events.json")) as f:
+        events = json.load(f)
+    assert {"event": "toy", "x": 1} in events["plan"]
+    assert any(n["event"] == "toy.ran" for n in events["notes"])
+
+
+def test_body_crash_is_a_failure_not_an_exception(tmp_path):
+    """A crashing body must still produce a result (with artifacts), so
+    a broken injector never takes down a whole smoke run."""
+    register("_toy-crashing", "body raises",
+             safety=[("s", lambda ctx, obs: None)],
+             liveness=[("l", lambda ctx, obs: None)])(
+                 lambda ctx: 1 / 0)
+    try:
+        r = run_scenario("_toy-crashing", seed=1, artifacts=str(tmp_path))
+    finally:
+        SCENARIOS.pop("_toy-crashing", None)
+    assert not r.ok
+    assert any("ZeroDivisionError" in f for f in r.failures)
+    assert r.artifact_dir and os.path.exists(
+        os.path.join(r.artifact_dir, "trace.json"))
+
+
+@pytest.mark.parametrize("name", SMOKE_ORDER)
+def test_smoke_scenario(name):
+    """Every smoke scenario passes its own safety+liveness post-mortem
+    at the default CI seed."""
+    r = run_scenario(name)
+    assert r.ok, f"{name} failed: {r.failures}"
+
+
+# -- CLI ------------------------------------------------------------------
+
+def test_cli_chaos_list(capsys):
+    from tendermint_tpu.cli import main
+    assert main(["chaos", "list"]) == 0
+    out = capsys.readouterr().out
+    for name in SCENARIOS:
+        assert name in out
+    assert main(["chaos", "list", "--json"]) == 0
+    catalog = json.loads(capsys.readouterr().out)
+    assert catalog["byz-equivocation"]["tier"] == "smoke"
+    assert catalog["crash-restart-storm"]["tier"] == "stress"
+    assert catalog["partition-heal"]["safety"]
+
+
+def test_cli_chaos_run_then_replay_matches(tmp_path, capsys):
+    """`chaos replay` re-runs from a dumped manifest and must report
+    MATCH — the artifact bundle is a faithful reproduction recipe."""
+    from tendermint_tpu.cli import main
+    rc = main(["chaos", "run", "--scenario", "device-wrong-answer",
+               "--seed", "7", "--artifacts", str(tmp_path),
+               "--keep-artifacts"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "PASS device-wrong-answer" in out
+    manifest = str(tmp_path / "device-wrong-answer-seed7" / "result.json")
+    assert os.path.exists(manifest)
+    rc = main(["chaos", "replay", "--manifest", manifest])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "MATCH" in out
+
+
+def test_cli_chaos_smoke_reports_budget_skips(capsys):
+    """The smoke runner never silently drops scenarios: past the
+    wall-clock budget the remainder is reported as SKIP lines."""
+    from tendermint_tpu.cli import main
+    rc = main(["chaos", "smoke", "--budget", "0.05"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "SKIP" in out
+    assert "skipped" in out.splitlines()[-1]

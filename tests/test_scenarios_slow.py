@@ -1,0 +1,28 @@
+"""The stress tier: heavyweight fault scenarios (faults + slow).
+
+Run explicitly with:
+
+    JAX_PLATFORMS=cpu python -m pytest tests/test_scenarios_slow.py -m faults
+
+or one at a time via `python -m tendermint_tpu.cli chaos run
+--scenario <name>` (same code path, plus artifacts on failure).
+"""
+
+import pytest
+
+from tendermint_tpu.scenarios import SCENARIOS, run_scenario
+
+pytestmark = [pytest.mark.faults, pytest.mark.slow]
+
+STRESS = sorted(n for n, sc in SCENARIOS.items() if not sc.smoke)
+
+
+def test_stress_catalog_is_what_we_think():
+    assert STRESS == ["crash-restart-storm", "partial-commit-replay",
+                     "partition-heal", "stale-commit-replay"]
+
+
+@pytest.mark.parametrize("name", STRESS)
+def test_stress_scenario(name):
+    r = run_scenario(name)
+    assert r.ok, f"{name} failed: {r.failures}"
