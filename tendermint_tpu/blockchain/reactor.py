@@ -129,16 +129,27 @@ class BlockchainReactor(Reactor):
         self.pool.remove_peer(peer.id)
 
     def _on_pool_evict(self, peer_id: str, reason: str) -> None:
-        if self.switch is not None:
-            p = self.switch.get_peer(peer_id)
-            if p is not None:
-                self.switch.stop_peer_for_error(p, reason)
+        if self.switch is None:
+            return
+        if reason.startswith("bad block"):
+            # a PROVEN commit lie (the typed commit checks — format /
+            # signature / power — failed on a block this peer served):
+            # immediate ban, not just a strike.  Timeout evictions land
+            # in the else-branch: slow is not malicious, no strike.
+            if self.switch.report_misbehavior(peer_id, reason, ban=True):
+                return               # report_misbehavior already removed it
+        p = self.switch.get_peer(peer_id)
+        if p is not None:
+            self.switch.stop_peer_for_error(p, reason)
 
     # -- inbound --------------------------------------------------------
     def receive(self, ch_id: int, peer: Peer, raw: bytes) -> None:
         try:
             msg = BM.decode_msg(raw)
         except (ValueError, IndexError) as e:
+            # fuzz-detected garbage: an undecodable message on an
+            # authenticated channel is the peer's doing — one strike
+            self.switch.report_misbehavior(peer.id, f"bad bc msg: {e}")
             self.switch.stop_peer_for_error(peer, f"bad bc msg: {e}")
             return
         if isinstance(msg, BM.BlockRequest):
@@ -154,6 +165,7 @@ class BlockchainReactor(Reactor):
             try:
                 block = msg.block()
             except (ValueError, IndexError) as e:
+                self.switch.report_misbehavior(peer.id, f"bad block: {e}")
                 self.switch.stop_peer_for_error(peer, f"bad block: {e}")
                 return
             if self.pool.add_block(peer.id, block):

@@ -542,6 +542,8 @@ def _print_scenario_result(result, as_json: bool) -> None:
           f"event_log_hash={result.event_log_hash[:16]}")
     for f in result.failures:
         print(f"  FAILED {f}")
+    for b in result.budget_breaches:
+        print(f"  OVER-BUDGET {b}")
     if result.artifact_dir:
         print(f"  artifacts: {result.artifact_dir}")
 
@@ -568,15 +570,38 @@ def cmd_chaos_list(args) -> int:
 
 
 def cmd_chaos_run(args) -> int:
-    """Run one scenario; exit 0 when every invariant held.  The same
-    --seed replays the same injected-fault schedule bit-identically
-    (verify with the printed event_log_hash)."""
-    from tendermint_tpu.scenarios import run_scenario
+    """Run one scenario; exit 0 when every invariant held and the run
+    stayed inside its declared budget.  The same --seed replays the same
+    injected-fault schedule bit-identically (verify with the printed
+    event_log_hash).  With --seed-range A:B the scenario is swept over
+    the half-open seed range instead."""
+    from tendermint_tpu.scenarios import (parse_seed_range, run_scenario,
+                                          run_sweep)
+    if getattr(args, "seed_range", ""):
+        seeds = parse_seed_range(args.seed_range)
+        out = run_sweep(
+            [args.scenario], seeds,
+            artifacts=args.artifacts or None,
+            keep_artifacts=args.keep_artifacts, ledger_path=None,
+            progress=(None if args.json
+                      else lambda r: _print_scenario_result(r, False)))
+        summary = out["summary"]
+        if args.json:
+            print(json.dumps(summary, indent=1))
+        else:
+            a = summary["configs"][args.scenario]
+            print(f"sweep {args.scenario} seeds {args.seed_range}: "
+                  f"{a['runs'] - a['failures']}/{a['runs']} passed, "
+                  f"{a['breaches']} over budget (mean "
+                  f"{a['mean_duration_s']}s, max {a['max_duration_s']}s, "
+                  f"budget {a['budget_s']}s)")
+        bad = summary["total_failures"] or summary["total_breaches"]
+        return 1 if bad else 0
     result = run_scenario(args.scenario, seed=args.seed,
                           artifacts=args.artifacts or None,
                           keep_artifacts=args.keep_artifacts)
     _print_scenario_result(result, args.json)
-    return 0 if result.ok else 1
+    return 0 if result.ok and not result.budget_breaches else 1
 
 
 def cmd_chaos_replay(args) -> int:
@@ -632,6 +657,92 @@ def cmd_chaos_smoke(args) -> int:
           f"passed, {len(skipped)} skipped "
           f"in {_time.time() - t0:.1f}s")
     return 1 if failed else 0
+
+
+def cmd_chaos_soak(args) -> int:
+    """Nightly seed-sweep soak: sweep a catalogue tier across a seed
+    range with per-scenario declared budgets and a global wall cap.
+    Never silent — scenarios that don't fit the global budget are
+    reported as SKIPPED, every failed or over-budget run prints its
+    triage bundle path, and per-scenario rates land in the chaos ledger
+    so a fault-path latency regression bisects like a bench regression.
+    Exits nonzero on any invariant failure or budget breach."""
+    import time as _time
+    from tendermint_tpu.scenarios import (SCENARIOS, SMOKE_ORDER,
+                                          parse_seed_range, run_sweep)
+    from tendermint_tpu.scenarios.engine import CHAOS_LEDGER_SCHEMA
+    from tendermint_tpu.utils import ledger as ledgermod
+    seeds = parse_seed_range(args.seed_range)
+    smoke = [n for n in SMOKE_ORDER if n in SCENARIOS]
+    smoke += sorted(n for n, sc in SCENARIOS.items()
+                    if sc.smoke and n not in smoke)
+    stress = sorted(n for n, sc in SCENARIOS.items() if not sc.smoke)
+    names = {"smoke": smoke, "stress": stress,
+             "all": smoke + stress}[args.tier]
+    if args.scenarios:
+        want = [s.strip() for s in args.scenarios.split(",") if s.strip()]
+        unknown = [w for w in want if w not in SCENARIOS]
+        if unknown:
+            print(f"unknown scenarios: {', '.join(unknown)} "
+                  f"(see `chaos list`)", file=sys.stderr)
+            return 2
+        names = want                       # explicit list overrides tier
+    t0 = _time.time()
+    skipped: list[str] = []
+    all_results: list = []
+    configs: dict = {}
+    progress = (None if args.json
+                else lambda r: _print_scenario_result(r, False))
+    for name in names:
+        if args.budget and _time.time() - t0 >= args.budget:
+            skipped.append(name)
+            continue
+        out = run_sweep([name], seeds, artifacts=args.artifacts or None,
+                        keep_artifacts=args.keep_artifacts,
+                        ledger_path=None, progress=progress)
+        configs.update(out["summary"]["configs"])
+        all_results.extend(out["results"])
+    failures = [r for r in all_results if not r.ok]
+    breaches = [r for r in all_results if r.budget_breaches]
+    deltas: dict = {}
+    if args.budget_ledger:
+        prior = [e for e in ledgermod.load(args.budget_ledger)
+                 if e.get("schema") == CHAOS_LEDGER_SCHEMA]
+        deltas = ledgermod.compute_deltas(prior, configs)
+        ledgermod.append_entry(args.budget_ledger, {
+            "schema": CHAOS_LEDGER_SCHEMA, "soak": True,
+            "tier": args.tier, "seed_range": args.seed_range,
+            "n_seeds": len(seeds), "configs": configs,
+            "skipped": skipped,
+            "timestamp": _time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                        _time.gmtime())})
+    if args.json:
+        print(json.dumps({
+            "tier": args.tier, "seed_range": args.seed_range,
+            "configs": configs, "skipped": skipped, "deltas": deltas,
+            "runs": len(all_results), "failures": len(failures),
+            "breaches": len(breaches),
+            "triage": sorted({r.artifact_dir for r in failures + breaches
+                              if r.artifact_dir}),
+            "duration_s": round(_time.time() - t0, 1)}, indent=1))
+        return 1 if failures or breaches else 0
+    for name in skipped:
+        print(f"SKIP {name} x{len(seeds)} seeds "
+              f"(global budget {args.budget:.0f}s spent)")
+    for d in sorted({r.artifact_dir for r in failures + breaches
+                     if r.artifact_dir}):
+        print(f"triage: {d}")
+    regressions = sorted(n for n, row in deltas.items()
+                         if row.get("regression"))
+    if regressions:
+        print(f"rate regressions vs best prior: {', '.join(regressions)}")
+    print(f"chaos soak [{args.tier}] seeds {args.seed_range}: "
+          f"{len(all_results) - len(failures)}/{len(all_results)} passed, "
+          f"{len(breaches)} over budget, {len(skipped)} scenarios "
+          f"skipped in {_time.time() - t0:.1f}s"
+          + (f" (ledger: {args.budget_ledger})"
+             if args.budget_ledger else ""))
+    return 1 if failures or breaches else 0
 
 
 def cmd_version(args) -> int:
@@ -827,6 +938,9 @@ def main(argv=None) -> int:
 
     csp = chaos_sub.add_parser("run", help="run one scenario")
     _chaos_common(csp, scenario_arg=True)
+    csp.add_argument("--seed-range", dest="seed_range", default="",
+                     help="sweep a half-open seed range A:B (e.g. 0:25) "
+                          "instead of the single --seed")
     csp.set_defaults(fn=cmd_chaos_run)
 
     csp = chaos_sub.add_parser(
@@ -848,6 +962,34 @@ def main(argv=None) -> int:
                           "don't fit are reported as skipped "
                           "(default: %(default)s)")
     csp.set_defaults(fn=cmd_chaos_smoke)
+
+    from tendermint_tpu.scenarios.engine import DEFAULT_CHAOS_LEDGER
+    csp = chaos_sub.add_parser(
+        "soak", help="nightly seed-sweep soak across a catalogue tier "
+                     "with budget enforcement and a chaos ledger")
+    csp.add_argument("--seed-range", dest="seed_range", default="0:3",
+                     help="half-open seed range A:B to sweep "
+                          "(default: %(default)s)")
+    csp.add_argument("--tier", choices=["smoke", "stress", "all"],
+                     default="smoke",
+                     help="catalogue tier to sweep (default: %(default)s)")
+    csp.add_argument("--scenarios", default="",
+                     help="comma-separated scenario names; overrides "
+                          "--tier when given")
+    csp.add_argument("--budget", type=float, default=0.0,
+                     help="global wall-clock cap in seconds; scenarios "
+                          "that don't fit are reported as SKIPPED, never "
+                          "silently dropped (0 = uncapped)")
+    csp.add_argument("--budget-ledger", dest="budget_ledger",
+                     default=DEFAULT_CHAOS_LEDGER,
+                     help="chaos ledger path for per-scenario rates and "
+                          "regression deltas; empty to disable "
+                          "(default: %(default)s)")
+    csp.add_argument("--artifacts", default="")
+    csp.add_argument("--keep-artifacts", dest="keep_artifacts",
+                     action="store_true")
+    csp.add_argument("--json", action="store_true")
+    csp.set_defaults(fn=cmd_chaos_soak)
 
     sp = sub.add_parser("version", help="print version")
     sp.set_defaults(fn=cmd_version)

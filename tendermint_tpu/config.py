@@ -66,6 +66,19 @@ class P2PConfig:
     fuzz_drop_prob: float = 0.05
     fuzz_delay_prob: float = 0.1
     fuzz_max_delay: float = 0.05
+    # persistent-peer reconnect: exponential backoff capped in SECONDS
+    # (reference p2p/switch.go reconnectToPeer), a separate attempt cap,
+    # and ±jitter_frac jitter so a healed partition doesn't thundering-
+    # herd every dialer onto the same instant
+    reconnect_max_attempts: int = 16
+    reconnect_backoff_base_s: float = 1.0
+    reconnect_backoff_max_s: float = 32.0
+    reconnect_jitter_frac: float = 0.2
+    # peer misbehavior scoring (p2p/switch.py): strikes accumulate per
+    # peer id (across reconnects); at ban_score the peer is evicted and
+    # refused in dial/accept for ban_window_s
+    misbehavior_ban_score: float = 3.0
+    misbehavior_ban_window_s: float = 30.0
 
 
 @dataclass

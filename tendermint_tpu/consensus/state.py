@@ -1109,9 +1109,15 @@ class ConsensusState:
                     round=self.round, type=type_, block_id=block_id)
         try:
             sig = self.priv_validator.sign_vote(self.state.chain_id, vote)
-        except DoubleSignError:
+        except DoubleSignError as e:
+            # Reference signAddVote logs the refusal and returns (:1593):
+            # raising here would abort the step transition that asked for
+            # the vote.  A validator restarted behind its own sign
+            # watermark must keep following the net (and commit via
+            # catch-up) without voting until it passes the watermark.
             if not self._replay_mode:
-                raise
+                log.warn("vote signing refused", height=self.height,
+                         round=self.round, step=self.step, err=str(e))
             return
         vote = Vote(**{**vote.__dict__, "signature": sig})
         # loop back through the queue; also hand to the gossip layer

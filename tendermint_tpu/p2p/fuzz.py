@@ -39,7 +39,7 @@ _live: "weakref.WeakSet[FuzzedConnection]" = weakref.WeakSet()
 # mutable probability fields set_profile() may touch at runtime
 _PROFILE_FIELDS = ("write_drop_prob", "write_delay_prob",
                    "read_drop_prob", "read_delay_prob",
-                   "max_delay", "read_stall")
+                   "max_delay", "read_stall", "write_garbage_prob")
 
 
 def live_connections() -> "list[FuzzedConnection]":
@@ -81,6 +81,12 @@ class FuzzedConnection:
                                 else read_delay_prob)
         self.read_stall = (max_delay * 25 if read_stall is None
                            else read_stall)
+        # corrupting-link mode: a selected write has one byte flipped.
+        # Below SecretConnection the peer sees a MAC failure, so garbage
+        # surfaces as a ValueError conn death — the signal the switch's
+        # misbehavior scoring classifies as transport garbage (vs a
+        # clean OSError disconnect, which is never scored)
+        self.write_garbage_prob = 0.0
         self.index = next(_conn_seq)
         self.seed = derived_seed(self.index) if seed is None else seed
         self._rng = random.Random(self.seed)
@@ -136,6 +142,11 @@ class FuzzedConnection:
             return                      # dropped on the floor
         if delay:
             time.sleep(delay)
+        if self.write_garbage_prob > 0.0 and data:
+            with self._lock:
+                if self._rng.random() < self.write_garbage_prob:
+                    i = self._rng.randrange(len(data))
+                    data = data[:i] + bytes([data[i] ^ 0xFF]) + data[i + 1:]
         self._conn.write(data)
 
     def read_exact(self, n: int) -> bytes:

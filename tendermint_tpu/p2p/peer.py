@@ -22,6 +22,10 @@ class Peer:
         self.persistent = persistent
         self.data: dict = {}            # reactor scratch (PeerState etc.)
         self._data_lock = threading.Lock()
+        # misbehavior strikes charged against this connection's peer id;
+        # the switch owns the authoritative per-id tally (it survives
+        # reconnects) and mirrors it here for net_info/debugging
+        self.misbehavior_score: float = 0.0
 
     @property
     def id(self) -> str:

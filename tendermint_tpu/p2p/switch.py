@@ -10,6 +10,7 @@ test suite builds multi-node consensus on.
 
 from __future__ import annotations
 
+import random
 import struct
 import threading
 import time
@@ -21,14 +22,42 @@ from tendermint_tpu.p2p.peer import Peer, Reactor
 from tendermint_tpu.p2p.secret import SecretConnection
 from tendermint_tpu.p2p.types import NetAddress, NodeInfo
 from tendermint_tpu.types.keys import PrivKey
+from tendermint_tpu.utils import chaos as chaosmod
 from tendermint_tpu.utils import lockwitness
 from tendermint_tpu.utils.log import get_logger
 from tendermint_tpu.utils.metrics import REGISTRY
 
 log = get_logger("p2p")
 
-RECONNECT_BACKOFF_BASE = 1.0
-RECONNECT_BACKOFF_MAX = 16
+# Reconnect limits (defaults when the switch has no P2PConfig).  The
+# attempt cap and the sleep ceiling are SEPARATE knobs: the old code's
+# single RECONNECT_BACKOFF_MAX=16 was consumed as an attempt count while
+# its name (and the reference reconnectToPeer) meant a seconds cap, so
+# neither limit actually held.
+RECONNECT_MAX_ATTEMPTS = 16
+RECONNECT_BACKOFF_BASE_S = 1.0
+RECONNECT_BACKOFF_MAX_S = 32.0
+RECONNECT_JITTER_FRAC = 0.2
+
+# misbehavior defaults (P2PConfig.misbehavior_* override)
+MISBEHAVIOR_BAN_SCORE = 3.0
+MISBEHAVIOR_BAN_WINDOW_S = 30.0
+
+DEFAULT_MAX_PEERS = 50
+
+
+def backoff_delay(attempt: int, rng,
+                  base_s: float = RECONNECT_BACKOFF_BASE_S,
+                  max_s: float = RECONNECT_BACKOFF_MAX_S,
+                  jitter_frac: float = RECONNECT_JITTER_FRAC) -> float:
+    """Sleep before reconnect `attempt` (0-based): exponential from
+    base_s, capped at max_s seconds, with ±jitter_frac multiplicative
+    jitter drawn from `rng` so the healed side of a partition doesn't
+    thundering-herd every dialer onto the same instant."""
+    capped = min(base_s * (2.0 ** attempt), max_s)
+    if jitter_frac <= 0.0:
+        return capped
+    return capped * (1.0 - jitter_frac + 2.0 * jitter_frac * rng.random())
 
 
 class SwitchError(Exception):
@@ -49,7 +78,34 @@ class Switch:
         self._stopped = threading.Event()
         self._dialing: set[str] = set()
         self._threads: list[threading.Thread] = []
+        self._threads_lock = lockwitness.new_lock("switch.threads",
+                                                  reentrant=False)
         self._persistent_addrs: dict[str, NetAddress] = {}
+        # misbehavior scoring + temporary bans, keyed by peer id so
+        # strikes survive reconnects (a liar can't reset its tally by
+        # redialing); guarded by one lock, never held across I/O
+        self._misbehavior: dict[str, float] = {}
+        self._banned: dict[str, float] = {}      # id -> monotonic expiry
+        self._ban_lock = lockwitness.new_lock("switch.ban",
+                                              reentrant=False)
+        # reconnect jitter RNG: derived from the installed ChaosConfig's
+        # master seed + our node id, so scenario runs replay the exact
+        # backoff schedule while distinct nodes still de-correlate
+        chaos_cfg = chaosmod.installed()
+        self._reconnect_rng = random.Random(chaosmod.derive_seed(
+            chaos_cfg.seed if chaos_cfg is not None else 0,
+            "p2p.reconnect", self.node_info.id))
+        self._rng_lock = lockwitness.new_lock("switch.reconnect_rng",
+                                              reentrant=False)
+        self._sleep = time.sleep     # fake-clock hook for reconnect tests
+
+    def _track_thread(self, t: threading.Thread) -> None:
+        """Track a helper thread for stop()-time join, reaping finished
+        ones first — soak runs dial thousands of times and the old
+        unconditional append leaked a list entry per attempt."""
+        with self._threads_lock:
+            self._threads = [x for x in self._threads if x.is_alive()]
+            self._threads.append(t)
 
     # -- reactor registry ----------------------------------------------
     def add_reactor(self, name: str, reactor: Reactor) -> Reactor:
@@ -81,7 +137,7 @@ class Switch:
                 t = threading.Thread(target=self._accept_routine,
                                      daemon=True, name="switch-accept")
                 t.start()
-                self._threads.append(t)
+                self._track_thread(t)
         if self.config is not None:
             for s in self.config.persistent_peers:
                 self.dial_peer_async(NetAddress.parse(s), persistent=True)
@@ -100,7 +156,9 @@ class Switch:
             r.stop()
         # bounded join so a stopped net leaves no accept/dial threads
         # gossiping into the next test's sockets
-        for t in self._threads:
+        with self._threads_lock:
+            threads = list(self._threads)
+        for t in threads:
             if t.is_alive():
                 t.join(timeout=1.0)
 
@@ -128,11 +186,13 @@ class Switch:
             "listeners": ([str(self._listener.addr)]
                           if self._listener is not None else []),
             "n_peers": len(peers),
+            "banned_peers": self.banned_peers(),
             "peers": [{
                 "id": p.id,
                 "moniker": p.node_info.moniker,
                 "listen_addr": p.node_info.listen_addr,
                 "is_outbound": p.outbound,
+                "misbehavior_score": p.misbehavior_score,
                 "connection_status": p.mconn.status(),
             } for p in peers],
         }
@@ -153,9 +213,17 @@ class Switch:
                              args=(addr, persistent), daemon=True,
                              name=f"dial-{addr.host}:{addr.port}")
         t.start()
-        self._threads.append(t)
+        self._track_thread(t)
 
-    def _dial_peer(self, addr: NetAddress, persistent: bool) -> Peer | None:
+    def _dial_peer(self, addr: NetAddress, persistent: bool,
+                   reschedule: bool = True) -> Peer | None:
+        """Dial + handshake one peer.  `reschedule=False` is the backoff
+        loop's re-entry: the loop owns the retry/attempt counting, so
+        failures here must not fork a second reconnect chain — but the
+        peer must still be CONSTRUCTED persistent, because a conn the
+        far side kills instantly (e.g. we are banned there) can die
+        before any after-the-fact persistent patching runs, silently
+        ending the chain."""
         key = addr.dial_string()
         with self._peers_lock:
             if key in self._dialing:
@@ -167,7 +235,7 @@ class Switch:
             conn = transport.dial(addr, timeout=timeout)
         except OSError as e:
             log.info("dial failed", addr=str(addr), err=str(e))
-            if persistent:
+            if persistent and reschedule:
                 self._schedule_reconnect(addr)
             return None
         finally:
@@ -182,30 +250,57 @@ class Switch:
         except Exception as e:
             log.info("handshake failed", addr=str(addr), err=str(e))
             conn.close()
-            if persistent:
+            if persistent and reschedule and "duplicate peer" not in str(e):
+                # a duplicate rejection means the peer is already back
+                # (e.g. a racing reconnect won) — looping would redial a
+                # connected peer forever
                 self._schedule_reconnect(addr)
             return None
 
+    def _reconnect_delay(self, attempt: int) -> float:
+        cfg = self.config
+        with self._rng_lock:
+            return backoff_delay(
+                attempt, self._reconnect_rng,
+                base_s=(cfg.reconnect_backoff_base_s if cfg is not None
+                        else RECONNECT_BACKOFF_BASE_S),
+                max_s=(cfg.reconnect_backoff_max_s if cfg is not None
+                       else RECONNECT_BACKOFF_MAX_S),
+                jitter_frac=(cfg.reconnect_jitter_frac if cfg is not None
+                             else RECONNECT_JITTER_FRAC))
+
     def _schedule_reconnect(self, addr: NetAddress, attempt: int = 0) -> None:
-        """Exponential backoff reconnect for persistent peers
-        (reference `reconnectToPeer` :402-434)."""
-        if self._stopped.is_set() or attempt >= RECONNECT_BACKOFF_MAX:
+        """Jittered exponential-backoff reconnect for persistent peers
+        (reference `reconnectToPeer` :402-434): sleeps are capped at
+        reconnect_backoff_max_s SECONDS, and the dialer gives up after
+        reconnect_max_attempts tries — two separate limits."""
+        max_attempts = (self.config.reconnect_max_attempts
+                        if self.config is not None
+                        else RECONNECT_MAX_ATTEMPTS)
+        if self._stopped.is_set():
             return
+        if attempt >= max_attempts:
+            log.info("reconnect gave up", addr=str(addr), attempts=attempt)
+            return
+        delay = self._reconnect_delay(attempt)
 
         def run():
-            time.sleep(RECONNECT_BACKOFF_BASE * (2 ** min(attempt, 8)))
+            self._sleep(delay)
             if self._stopped.is_set():
                 return
-            peer = self._dial_peer(addr, persistent=False)
+            known = next((p for p, a in self._persistent_addrs.items()
+                          if a.dial_string() == addr.dial_string()), None)
+            if known is not None and self.get_peer(known) is not None:
+                return          # already back: a racing dial/accept won
+            REGISTRY.switch_reconnect_attempts.inc()
+            peer = self._dial_peer(addr, persistent=True,
+                                   reschedule=False)
             if peer is None:
                 self._schedule_reconnect(addr, attempt + 1)
-            else:
-                peer.persistent = True
-                self._persistent_addrs[peer.id] = addr
 
         t = threading.Thread(target=run, daemon=True, name="reconnect")
         t.start()
-        self._threads.append(t)
+        self._track_thread(t)
 
     # -- accept ---------------------------------------------------------
     def _accept_routine(self) -> None:
@@ -229,6 +324,67 @@ class Switch:
             log.info("inbound handshake failed", err=str(e))
             conn.close()
 
+    # -- misbehavior scoring + temporary bans ----------------------------
+    def is_banned(self, peer_id: str) -> bool:
+        """True while peer_id is inside its ban window (expired entries
+        are purged on read, so a served-out ban clears itself)."""
+        now = time.monotonic()
+        with self._ban_lock:
+            until = self._banned.get(peer_id)
+            if until is None:
+                return False
+            if now >= until:
+                del self._banned[peer_id]
+                return False
+            return True
+
+    def misbehavior_score(self, peer_id: str) -> float:
+        """Current strike tally for peer_id (0.0 for clean/unknown)."""
+        with self._ban_lock:
+            return self._misbehavior.get(peer_id, 0.0)
+
+    def banned_peers(self) -> dict[str, float]:
+        """{peer_id: seconds_remaining} for peers currently banned."""
+        now = time.monotonic()
+        with self._ban_lock:
+            return {pid: round(until - now, 3)
+                    for pid, until in self._banned.items() if until > now}
+
+    def report_misbehavior(self, peer_id: str, reason,
+                           weight: float = 1.0, ban: bool = False) -> bool:
+        """Charge a misbehavior strike against `peer_id` (reactors call
+        this for protocol lies — bad commits, undecodable garbage —
+        NEVER for slowness or our own device faults).  Strikes accumulate
+        across reconnects; at misbehavior_ban_score (or immediately with
+        `ban=True`, for proven lies like a failed commit check) the peer
+        is evicted and refused in dial/accept for
+        misbehavior_ban_window_s.  Returns True when this report crossed
+        the ban line."""
+        cfg = self.config
+        score_limit = (cfg.misbehavior_ban_score if cfg is not None
+                       else MISBEHAVIOR_BAN_SCORE)
+        window_s = (cfg.misbehavior_ban_window_s if cfg is not None
+                    else MISBEHAVIOR_BAN_WINDOW_S)
+        with self._ban_lock:
+            score = self._misbehavior.get(peer_id, 0.0) + weight
+            self._misbehavior[peer_id] = score
+            should_ban = ban or score >= score_limit
+            if should_ban:
+                self._banned[peer_id] = time.monotonic() + window_s
+                self._misbehavior.pop(peer_id, None)
+        peer = self.get_peer(peer_id)
+        if peer is not None:
+            peer.misbehavior_score = score
+        log.info("peer misbehavior", peer=peer_id[:12],
+                 score=round(score, 2), reason=str(reason)[:80])
+        if should_ban:
+            REGISTRY.switch_peers_evicted.inc()
+            log.info("peer banned", peer=peer_id[:12], window_s=window_s,
+                     reason=str(reason)[:80])
+            if peer is not None:
+                self._remove_peer(peer, f"banned: {reason}")
+        return should_ban
+
     # -- the add-peer pipeline (reference :206-253) ----------------------
     def add_peer_from_conn(self, raw_conn, outbound: bool,
                            persistent: bool = False) -> Peer | None:
@@ -246,6 +402,9 @@ class Switch:
             raise SwitchError("node info pubkey != authenticated conn key")
         if info.id == self.node_info.id:
             raise SwitchError("connected to self")
+        if self.is_banned(info.id):
+            raise SwitchError(f"peer {info.id[:12]} is banned "
+                              f"(misbehavior)")
         self.node_info.compatible_with(info)
         mconn_kwargs = {}
         if cfg is not None:
@@ -268,10 +427,32 @@ class Switch:
                             **mconn_kwargs)
         peer = Peer(info, mconn, outbound, persistent)
         peer_holder.append(peer)
+        with self._ban_lock:
+            peer.misbehavior_score = self._misbehavior.get(info.id, 0.0)
+        max_peers = (cfg.max_num_peers if cfg is not None
+                     else DEFAULT_MAX_PEERS)
         with self._peers_lock:
             if info.id in self._peers:
                 raise SwitchError(f"duplicate peer {info.id[:12]}")
+            # the cap must be enforced under the same lock as the insert:
+            # the accept routine's pre-handshake check is only a fast
+            # path, and a heal storm's simultaneous handshakes would all
+            # pass it and overshoot max_num_peers
+            if len(self._peers) >= max_peers:
+                raise SwitchError(f"too many peers "
+                                  f"({len(self._peers)}/{max_peers})")
             self._peers[info.id] = peer
+        # re-check the ban after the insert: a handshake that passed the
+        # pre-handshake ban check can finish AFTER a report lands, and
+        # letting it register would re-admit a just-banned peer inside
+        # its window (checked post-insert to keep ban/peers lock
+        # ordering flat for the lock witness)
+        if self.is_banned(info.id):
+            with self._peers_lock:
+                if self._peers.get(info.id) is peer:
+                    del self._peers[info.id]
+            raise SwitchError(f"peer {info.id[:12]} is banned "
+                              f"(misbehavior)")
         REGISTRY.peers.set(self.n_peers())
         mconn.start()
         for r in self._reactors.values():
@@ -292,8 +473,19 @@ class Switch:
 
     # -- removal --------------------------------------------------------
     def stop_peer_for_error(self, peer: Peer, reason) -> None:
-        self._remove_peer(peer, reason)
-        if peer.persistent:
+        # classify the death: framing/MAC garbage (ValueError from the
+        # fuzz/secret/mconn stack) is a misbehavior strike — a corrupting
+        # or lying link; clean socket deaths (OSError/ConnectionError)
+        # are our network's fault, never the peer's
+        if isinstance(reason, ValueError):
+            self.report_misbehavior(peer.id,
+                                    f"transport garbage: {reason}")
+        if not self._remove_peer(peer, reason):
+            # stale death notification: this id already reconnected and a
+            # NEWER peer object owns the slot — don't tear that one down,
+            # and don't spawn a redundant reconnect loop for it either
+            return
+        if peer.persistent and not self.is_banned(peer.id):
             addr = self._persistent_addrs.get(peer.id)
             if addr is None and peer.node_info.listen_addr:
                 addr = NetAddress.parse(peer.node_info.listen_addr)
@@ -303,17 +495,27 @@ class Switch:
     def stop_peer_gracefully(self, peer: Peer) -> None:
         self._remove_peer(peer, None)
 
-    def _remove_peer(self, peer: Peer, reason) -> None:
+    def _remove_peer(self, peer: Peer, reason) -> bool:
+        """Unregister THIS peer object.  Removal is identity-checked, not
+        id-checked: after a reconnect the same peer id maps to a fresh
+        Peer, and a late death notification from the replaced
+        connection's reader thread must only stop its own (dead) conn —
+        popping by id here used to evict the healthy successor and leave
+        its MConnection running unregistered, wedging the sync.  Returns
+        True when this object was the registered one."""
         with self._peers_lock:
-            existing = self._peers.pop(peer.id, None)
-        if existing is None:
-            return                       # already removed
+            existing = self._peers.get(peer.id)
+            if existing is not peer:
+                peer.stop()              # stale object: just reap its conn
+                return False
+            del self._peers[peer.id]
         peer.stop()
         REGISTRY.peers.set(self.n_peers())
         for r in self._reactors.values():
             r.remove_peer(peer, reason)
         if reason is not None:
             log.info("removed peer", peer=peer.id[:12], reason=str(reason))
+        return True
 
 
 # ---------------------------------------------------------------------------

@@ -9,13 +9,16 @@ for the seed-replay contract and `catalog.py` for the shipped
 scenarios; drive from the command line with `cli chaos`.
 """
 
-from tendermint_tpu.scenarios.engine import (DEFAULT_SEED, SCENARIOS,
+from tendermint_tpu.scenarios.engine import (DEFAULT_CHAOS_LEDGER,
+                                             DEFAULT_SEED, SCENARIOS,
                                              InvariantViolation,
                                              ScenarioResult, artifacts_root,
-                                             register, run_scenario)
+                                             parse_seed_range, register,
+                                             run_scenario, run_sweep)
 from tendermint_tpu.scenarios import catalog  # registers the shipped set
 from tendermint_tpu.scenarios.catalog import SMOKE_ORDER
 
-__all__ = ["DEFAULT_SEED", "SCENARIOS", "SMOKE_ORDER",
-           "InvariantViolation", "ScenarioResult", "artifacts_root",
-           "catalog", "register", "run_scenario"]
+__all__ = ["DEFAULT_CHAOS_LEDGER", "DEFAULT_SEED", "SCENARIOS",
+           "SMOKE_ORDER", "InvariantViolation", "ScenarioResult",
+           "artifacts_root", "catalog", "parse_seed_range", "register",
+           "run_scenario", "run_sweep"]

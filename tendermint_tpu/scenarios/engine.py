@@ -45,12 +45,15 @@ identically with `cli chaos run --scenario <name> --seed <N>`.
 
 from __future__ import annotations
 
+import contextlib
 import hashlib
 import json
 import os
+import threading
 import time
 
 from tendermint_tpu.utils import chaos as chaosmod
+from tendermint_tpu.utils import lockwitness
 from tendermint_tpu.utils import tracing
 from tendermint_tpu.utils.log import get_logger
 from tendermint_tpu.utils.metrics import REGISTRY
@@ -69,22 +72,53 @@ class InvariantViolation(AssertionError):
 
 
 class EventLog:
-    """Deterministic plan stream + timing-dependent note stream."""
+    """Deterministic plan stream + timing-dependent note stream.
+
+    Concurrency: notes may arrive from any injector thread (the lock
+    serializes them); plan events may NOT — their ORDER is part of the
+    hash, and thread interleaving would make it timing-dependent.  The
+    engine seals the plan stream while scheduled injectors run
+    concurrently (`sealed_plan`), so a plan() from inside a concurrent
+    injector fails loudly instead of silently breaking replay."""
 
     def __init__(self):
         self._plan: list[dict] = []
         self._notes: list[dict] = []
+        self._sealed = False
+        self._lock = lockwitness.new_lock("scenarios.eventlog",
+                                          reentrant=False)
 
     def plan(self, event: str, **fields) -> None:
         """Record one planned injection.  Fields must be JSON-safe and
         derived only from the seed (never wall-clock) — they are hashed
         into the determinism contract."""
-        self._plan.append({"event": event, **fields})
+        with self._lock:
+            if self._sealed:
+                raise RuntimeError(
+                    f"plan event {event!r} emitted while the plan stream "
+                    f"is sealed (concurrent injectors are running): plan "
+                    f"order would be timing-dependent and break the "
+                    f"event_log_hash replay contract — derive the whole "
+                    f"schedule before InjectorSchedule.run()")
+            self._plan.append({"event": event, **fields})
 
     def note(self, event: str, **fields) -> None:
         """Record a runtime observation (not hashed)."""
-        self._notes.append({"t": round(time.time(), 6),
-                            "event": event, **fields})
+        with self._lock:
+            self._notes.append({"t": round(time.time(), 6),
+                                "event": event, **fields})
+
+    @contextlib.contextmanager
+    def sealed_plan(self):
+        """Freeze the plan stream (plan() raises) while concurrent
+        injector threads run; notes stay open."""
+        with self._lock:
+            self._sealed = True
+        try:
+            yield
+        finally:
+            with self._lock:
+                self._sealed = False
 
     def hash(self) -> str:
         blob = json.dumps(self._plan, sort_keys=True,
@@ -145,6 +179,87 @@ class ScenarioContext:
                 return snap["metrics"]
         return None
 
+    # -- composable injector schedules ----------------------------------
+    def schedule(self, label: str = "schedule") -> "InjectorSchedule":
+        """A combined-adversary schedule: declare several injectors with
+        seed-derived phase offsets, then run them CONCURRENTLY."""
+        return InjectorSchedule(self, label)
+
+
+class InjectorSchedule:
+    """Multiple injectors running concurrently with seed-derived phase
+    offsets, folded into the one event_log_hash replay contract.
+
+    Declaration (`add`) is single-threaded and emits the plan events:
+    each entry's offset = `after` + U(0, jitter_s) drawn from the
+    scenario seed, so the combined schedule replays bit-identically.
+    Execution (`run`) spawns one thread per entry, SEALS the plan stream
+    for the duration (injector bodies must have derived their whole
+    schedule already — runtime effects record notes only), sleeps each
+    entry to its offset, and joins them all.  Injector exceptions are
+    collected and re-raised after the join so one broken injector never
+    strands the others' threads."""
+
+    def __init__(self, ctx: ScenarioContext, label: str = "schedule"):
+        self.ctx = ctx
+        self.label = label
+        self._entries: list[tuple[str, float, object]] = []
+
+    def add(self, name: str, fn, *, after: float = 0.0,
+            jitter_s: float = 0.0) -> float:
+        """Declare injector `name` (a zero-arg callable) to fire at
+        `after` + seed-derived U(0, jitter_s) seconds into run().
+        Returns the planned offset."""
+        offset = float(after)
+        if jitter_s > 0.0:
+            rng = self.ctx.rng(f"{self.label}.{name}")
+            offset += rng.random() * float(jitter_s)
+        offset = round(offset, 6)
+        self.ctx.plan("injector-schedule", schedule=self.label,
+                      name=name, offset_s=offset)
+        self._entries.append((name, offset, fn))
+        return offset
+
+    def run(self, join_timeout_s: float = 120.0) -> None:
+        """Fire every declared injector at its offset, concurrently."""
+        errors: list[tuple[str, BaseException]] = []
+        err_lock = threading.Lock()
+
+        def runner(name: str, offset: float, fn) -> None:
+            time.sleep(offset)
+            self.ctx.note("injector.fire", schedule=self.label, name=name,
+                          offset_s=offset)
+            try:
+                fn()
+            except BaseException as e:  # noqa: BLE001 - surfaced after join
+                with err_lock:
+                    errors.append((name, e))
+                self.ctx.note("injector.error", schedule=self.label,
+                              name=name,
+                              error=f"{type(e).__name__}: {e}")
+            else:
+                self.ctx.note("injector.done", schedule=self.label,
+                              name=name)
+
+        threads = [threading.Thread(target=runner, args=e, daemon=True,
+                                    name=f"injector-{e[0]}")
+                   for e in self._entries]
+        with self.ctx.log.sealed_plan():
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=join_timeout_s)
+        alive = [t.name for t in threads if t.is_alive()]
+        if alive:
+            raise RuntimeError(
+                f"injector schedule {self.label!r} timed out: "
+                f"{alive} still running after {join_timeout_s}s")
+        if errors:
+            name, exc = errors[0]
+            raise RuntimeError(
+                f"injector {name!r} in schedule {self.label!r} failed: "
+                f"{type(exc).__name__}: {exc}") from exc
+
 
 class Scenario:
     """A registered scenario: body + named safety/liveness invariants.
@@ -156,7 +271,8 @@ class Scenario:
     it so a scenario cannot silently ship without a post-mortem."""
 
     def __init__(self, name: str, description: str, body,
-                 safety: list, liveness: list, smoke: bool = False):
+                 safety: list, liveness: list, smoke: bool = False,
+                 budget_s: float | None = None):
         if not safety or not liveness:
             raise ValueError(
                 f"scenario {name!r} needs >=1 safety and >=1 liveness "
@@ -167,20 +283,32 @@ class Scenario:
         self.safety = list(safety)
         self.liveness = list(liveness)
         self.smoke = smoke
+        # declared wall-clock budget per run: a run over budget is a
+        # BUDGET BREACH (soak exits nonzero on it, the chaos ledger
+        # records it) — a fault-path latency regression bisects exactly
+        # like a correctness regression
+        self.budget_s = float(budget_s) if budget_s is not None else (
+            DEFAULT_SMOKE_BUDGET_S if smoke else DEFAULT_STRESS_BUDGET_S)
 
+
+# default declared budgets (seconds per run) when a scenario doesn't
+# declare its own via register(budget_s=...)
+DEFAULT_SMOKE_BUDGET_S = 120.0
+DEFAULT_STRESS_BUDGET_S = 420.0
 
 SCENARIOS: dict[str, Scenario] = {}
 
 
 def register(name: str, description: str, safety: list, liveness: list,
-             smoke: bool = False):
+             smoke: bool = False, budget_s: float | None = None):
     """Decorator: `@register("byz-equivocation", "...", safety=[...],
     liveness=[...])` over the scenario body."""
     def deco(fn):
         if name in SCENARIOS:
             raise ValueError(f"duplicate scenario {name!r}")
         SCENARIOS[name] = Scenario(name, description, fn,
-                                   safety, liveness, smoke=smoke)
+                                   safety, liveness, smoke=smoke,
+                                   budget_s=budget_s)
         return fn
     return deco
 
@@ -188,7 +316,9 @@ def register(name: str, description: str, safety: list, liveness: list,
 class ScenarioResult:
     def __init__(self, name: str, seed: int, ok: bool, failures: list[str],
                  event_log_hash: str, duration_s: float,
-                 observations: dict, artifact_dir: str | None):
+                 observations: dict, artifact_dir: str | None,
+                 budget_s: float | None = None,
+                 budget_breaches: list[str] | None = None):
         self.name = name
         self.seed = seed
         self.ok = ok
@@ -197,12 +327,18 @@ class ScenarioResult:
         self.duration_s = duration_s
         self.observations = observations
         self.artifact_dir = artifact_dir
+        self.budget_s = budget_s
+        # breaches are tracked apart from invariant failures: the run's
+        # VERDICT stays about correctness, but soak exits nonzero on both
+        self.budget_breaches = list(budget_breaches or [])
 
     def to_dict(self) -> dict:
         return {"scenario": self.name, "seed": self.seed, "ok": self.ok,
                 "failures": self.failures,
                 "event_log_hash": self.event_log_hash,
                 "duration_s": round(self.duration_s, 3),
+                "budget_s": self.budget_s,
+                "budget_breaches": self.budget_breaches,
                 "observations": _json_safe(self.observations),
                 "artifact_dir": self.artifact_dir}
 
@@ -293,11 +429,21 @@ def run_scenario(name: str, seed: int = DEFAULT_SEED,
                              ok=False, error=f"{type(e).__name__}: {e}")
     finally:
         chaosmod.install(prev_cfg)
+    duration_s = time.perf_counter() - t0
+    breaches: list[str] = []
+    if sc.budget_s is not None and duration_s > sc.budget_s:
+        breaches.append(
+            f"wall-clock {duration_s:.1f}s over declared budget "
+            f"{sc.budget_s:.1f}s")
     result = ScenarioResult(
         name=name, seed=seed, ok=not failures, failures=failures,
         event_log_hash=ctx.log.hash(),
-        duration_s=time.perf_counter() - t0,
-        observations=obs, artifact_dir=None)
+        duration_s=duration_s,
+        observations=obs, artifact_dir=None,
+        budget_s=sc.budget_s, budget_breaches=breaches)
+    if breaches:
+        log.warning("scenario over budget", scenario=name, seed=seed,
+                    duration_s=round(duration_s, 1), budget_s=sc.budget_s)
     if failures or keep_artifacts:
         try:
             result.artifact_dir = _dump_artifacts(
@@ -308,3 +454,100 @@ def run_scenario(name: str, seed: int = DEFAULT_SEED,
             log.error("scenario artifact dump failed", scenario=name,
                       error=str(e))
     return result
+
+
+# -- seed-sweep soak ------------------------------------------------------
+
+CHAOS_LEDGER_SCHEMA = "tpu-bft-chaos-ledger/1"
+DEFAULT_CHAOS_LEDGER = "CHAOS_LEDGER.jsonl"
+
+
+def parse_seed_range(spec: str) -> list[int]:
+    """`"A:B"` -> half-open [A, B) (so `0:25` is 25 seeds); a bare
+    integer is a single-seed range."""
+    spec = spec.strip()
+    try:
+        if ":" not in spec:
+            return [int(spec)]
+        a_s, b_s = spec.split(":", 1)
+        a, b = int(a_s), int(b_s)
+    except ValueError:
+        raise ValueError(
+            f"bad seed range {spec!r}: expected 'A:B' (half-open) or a "
+            f"single integer") from None
+    if b <= a:
+        raise ValueError(f"bad seed range {spec!r}: B must be > A "
+                         f"(half-open [A, B))")
+    return list(range(a, b))
+
+
+def run_sweep(names: list[str], seeds: list[int],
+              artifacts: str | None = None, keep_artifacts: bool = False,
+              ledger_path: str | None = None,
+              progress=None) -> dict:
+    """Soak: run every scenario in `names` across every seed in `seeds`,
+    aggregate per-scenario stats, and (unless `ledger_path` is None)
+    append a chaos-ledger entry whose per-scenario `runs_per_sec` rate
+    plugs into `utils.ledger.compute_deltas` — a fault-path latency
+    regression shows up in `cli chaos soak` history exactly like a bench
+    regression.  `progress`, when given, is called with each
+    ScenarioResult as it lands (never-silent soak reporting)."""
+    unknown = [n for n in names if n not in SCENARIOS]
+    if unknown:
+        raise KeyError(f"unknown scenarios {unknown}; "
+                       f"known: {sorted(SCENARIOS)}")
+    if not seeds:
+        raise ValueError("empty seed list")
+    results: list[ScenarioResult] = []
+    agg: dict[str, dict] = {
+        n: {"runs": 0, "failures": 0, "breaches": 0,
+            "budget_s": SCENARIOS[n].budget_s, "total_duration_s": 0.0,
+            "max_duration_s": 0.0, "failed_seeds": [], "breached_seeds": []}
+        for n in names}
+    for n in names:
+        for seed in seeds:
+            r = run_scenario(n, seed=seed, artifacts=artifacts,
+                             keep_artifacts=keep_artifacts)
+            results.append(r)
+            a = agg[n]
+            a["runs"] += 1
+            a["total_duration_s"] += r.duration_s
+            a["max_duration_s"] = max(a["max_duration_s"], r.duration_s)
+            if not r.ok:
+                a["failures"] += 1
+                a["failed_seeds"].append(seed)
+            if r.budget_breaches:
+                a["breaches"] += 1
+                a["breached_seeds"].append(seed)
+            if progress is not None:
+                progress(r)
+    configs: dict[str, dict] = {}
+    for n, a in agg.items():
+        total = a.pop("total_duration_s")
+        a["mean_duration_s"] = round(total / a["runs"], 3)
+        a["max_duration_s"] = round(a["max_duration_s"], 3)
+        # headline rate for ledger.compute_deltas/render_history: a
+        # latency regression in the fault path appears as a rate drop
+        a["runs_per_sec"] = round(a["runs"] / total, 4) if total > 0 else 0.0
+        configs[n] = dict(a)
+    summary = {
+        "schema": CHAOS_LEDGER_SCHEMA,
+        "seeds": [seeds[0], seeds[-1] + 1] if seeds == list(
+            range(seeds[0], seeds[-1] + 1)) else list(seeds),
+        "n_seeds": len(seeds),
+        "configs": configs,
+        "total_runs": len(results),
+        "total_failures": sum(a["failures"] for a in configs.values()),
+        "total_breaches": sum(a["breaches"] for a in configs.values()),
+    }
+    if ledger_path is not None:
+        from tendermint_tpu.utils import ledger as ledgermod
+        entry = dict(summary)
+        entry["timestamp"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                           time.gmtime())
+        prior = [e for e in ledgermod.load(ledger_path)
+                 if e.get("schema") == CHAOS_LEDGER_SCHEMA]
+        summary["deltas"] = ledgermod.compute_deltas(prior, configs)
+        ledgermod.append_entry(ledger_path, entry)
+        summary["ledger_path"] = os.path.abspath(ledger_path)
+    return {"summary": summary, "results": results}

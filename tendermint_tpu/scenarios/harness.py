@@ -92,11 +92,24 @@ def wire_net(chain_id: str, n: int, app: str = "kvstore",
     return nodes, privs, gen
 
 
+def start_wire_net(nodes: list[WireNode], stagger_s: float = 0.0) -> None:
+    """Start every WireNode's consensus state, optionally staggered —
+    late starters model operators bringing a big net up one node at a
+    time; rounds must still converge once +2/3 are live."""
+    for i, nd in enumerate(nodes):
+        nd.cs.start()
+        if stagger_s > 0.0 and i < len(nodes) - 1:
+            time.sleep(stagger_s)
+
+
 # -- fast-sync rig ----------------------------------------------------------
 
-def fastsync_source(chain_id: str, chain, gen, moniker: str = "source"):
+def fastsync_source(chain_id: str, chain, gen, moniker: str = "source",
+                    config=None):
     """A served chain: store + state advanced to the tip, behind a
-    switch.  Returns (switch, state, store)."""
+    switch.  Returns (switch, state, store).  Pass a P2PConfig with a
+    TCP `laddr` to make the source dialable (the rig for persistent-
+    peer reconnect scenarios)."""
     state = get_state(MemDB(), gen)
     conns = ClientCreator("kvstore").new_app_conns()
     store = BlockStore(MemDB())
@@ -107,13 +120,17 @@ def fastsync_source(chain_id: str, chain, gen, moniker: str = "source"):
                               check_last_commit=False)
     reactor = BlockchainReactor(state, conns.consensus, store,
                                 fast_sync=False)
-    sw = make_switch(chain_id, {"blockchain": reactor}, moniker=moniker)
+    sw = make_switch(chain_id, {"blockchain": reactor}, config=config,
+                     moniker=moniker)
     return sw, state, store
 
 
-def fastsync_syncer(chain_id: str, gen, batch_size: int = 8):
+def fastsync_syncer(chain_id: str, gen, batch_size: int = 8,
+                    fuzz: bool = False):
     """A fresh syncing node.  Returns (switch, bc_reactor, cons_reactor,
-    store)."""
+    store).  With `fuzz=True` every link gets an inert FuzzedConnection
+    wrapper (zero probabilities) so partition injectors can sever
+    individual source links mid-sync."""
     state = get_state(MemDB(), gen)
     conns = ClientCreator("kvstore").new_app_conns()
     store = BlockStore(MemDB())
@@ -124,10 +141,31 @@ def fastsync_syncer(chain_id: str, gen, batch_size: int = 8):
     bc_reactor = BlockchainReactor(state, conns.consensus, store,
                                    fast_sync=True, batch_size=batch_size)
     bc_reactor.on_caught_up = cons_reactor.switch_to_consensus
+    p2p_cfg = None
+    if fuzz:
+        p2p_cfg = test_config().p2p
+        p2p_cfg.laddr = ""
+        p2p_cfg.fuzz = True
+        p2p_cfg.fuzz_drop_prob = 0.0
+        p2p_cfg.fuzz_delay_prob = 0.0
     sw = make_switch(chain_id, {"blockchain": bc_reactor,
                                 "consensus": cons_reactor},
-                     moniker="syncer")
+                     config=p2p_cfg, moniker="syncer")
     return sw, bc_reactor, cons_reactor, store
+
+
+def fuzz_link_to(switch, peer_id: str) -> FuzzedConnection | None:
+    """The FuzzedConnection wrapping `switch`'s link to `peer_id`, or
+    None when the peer is absent or the link is unfuzzed — the handle
+    for asymmetric partitions that sever ONE link of a multi-peer
+    switch while the others keep flowing."""
+    for peer in switch.peers():
+        if peer.id != peer_id:
+            continue
+        inner = getattr(peer.mconn.conn, "_conn", None)
+        if isinstance(inner, FuzzedConnection):
+            return inner
+    return None
 
 
 # -- reactor net (real p2p, fuzz wrappers in the stack) ---------------------
@@ -138,6 +176,10 @@ class ReactorNode:
     def __init__(self, priv, gen, chain_id: str, moniker: str,
                  cfg: Config | None = None, fuzz: bool = False):
         cfg = cfg or test_config()
+        # kept for crash-restart rigs: rebuilding a node from genesis
+        # needs (priv, gen, chain_id) back
+        self.priv = priv
+        self.gen = gen
         cfg.p2p.laddr = ""        # in-memory pairs only, no TCP listener
         if fuzz:
             # wrappers with zero probabilities: inert until an injector
@@ -177,17 +219,69 @@ class ReactorNode:
         self.switch.stop()
 
 
+def config_with_timeouts(timeouts: dict[str, float] | None) -> Config:
+    """test_config with consensus timeouts overridden.  The defaults
+    (20-100ms) are tuned for <=5-node rigs; a 10+ node net on pure-python
+    crypto needs propose/prevote windows that cover its verify load or
+    every height burns rounds on timeouts."""
+    cfg = test_config()
+    for k, v in (timeouts or {}).items():
+        if not hasattr(cfg.consensus, k):
+            raise ValueError(f"unknown consensus timeout field {k!r}")
+        setattr(cfg.consensus, k, v)
+    return cfg
+
+
+def start_reactor_net(nodes: list[ReactorNode],
+                      stagger_s: float = 0.0) -> None:
+    """Rolling bring-up of a reactor net: each node starts, meshes with
+    the already-live prefix, and (optionally) the next waits stagger_s —
+    a 10-50 node net coming up one operator at a time."""
+    for i, nd in enumerate(nodes):
+        nd.start()
+        for j in range(i):
+            connect_switches(nodes[j].switch, nd.switch)
+        if stagger_s > 0.0 and i < len(nodes) - 1:
+            time.sleep(stagger_s)
+
+
 def reactor_net(chain_id: str, n: int, fuzz: bool = False,
-                seed: int = 0) -> tuple[list[ReactorNode], list]:
+                seed: int = 0, stagger_s: float = 0.0,
+                profiles: dict[int, dict] | None = None,
+                timeouts: dict[str, float] | None = None,
+                autostart: bool = True,
+                ) -> tuple[list[ReactorNode], list]:
+    """Full-mesh reactor net, sized for 10-50 validator rigs.
+
+    `stagger_s` sleeps between node bring-ups (each node connects to the
+    already-started prefix as it comes up), modeling a rolling start of
+    a big net.  `profiles` maps node index -> fuzz profile fields
+    (see FuzzedConnection.set_profile) applied to that node's links once
+    the mesh is wired — per-node fault profiles, e.g. one flaky-link
+    node in an otherwise clean net.  Profiles need `fuzz=True`.
+    `timeouts` overrides consensus timeouts on every node (see
+    config_with_timeouts).  `autostart=False` returns the net built but
+    not started, so injector hooks can install before height 1."""
+    if profiles and not fuzz:
+        raise ValueError("per-node fault profiles need fuzz=True "
+                         "(no FuzzedConnection wrappers to flip otherwise)")
+    bad = [i for i in (profiles or {}) if not 0 <= i < n]
+    if bad:
+        raise ValueError(f"profile indices {bad} out of range for n={n}")
     privs, _vs = fixtures.make_validators(n, seed=seed)
     gen = fixtures.make_genesis(chain_id, privs)
-    nodes = [ReactorNode(privs[i], gen, chain_id, f"node{i}", fuzz=fuzz)
+    nodes = [ReactorNode(privs[i], gen, chain_id, f"node{i}",
+                         cfg=config_with_timeouts(timeouts), fuzz=fuzz)
              for i in range(n)]
-    for nd in nodes:
-        nd.start()
-    for i in range(n):
-        for j in range(i + 1, n):
-            connect_switches(nodes[i].switch, nodes[j].switch)
+    if autostart:
+        start_reactor_net(nodes, stagger_s=stagger_s)
+        for idx, prof in (profiles or {}).items():
+            for link in nodes[idx].fuzz_links():
+                link.set_profile(**prof)
+    elif profiles:
+        raise ValueError("profiles need autostart=True (links exist only "
+                         "after the mesh is wired); apply them after "
+                         "start_reactor_net instead")
     return nodes, privs
 
 

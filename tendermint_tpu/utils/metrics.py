@@ -269,6 +269,12 @@ class Registry:
         self.peers = Gauge()
         self.msgs_sent = Counter()
         self.msgs_received = Counter()
+        # p2p self-healing plane (p2p/switch.py): reconnect attempts are
+        # the graceful-degradation signal under partitions (a heal storm
+        # shows as a burst, a dead peer as a bounded trickle); evictions
+        # count misbehavior-score bans, never plain connection deaths
+        self.switch_reconnect_attempts = Counter()
+        self.switch_peers_evicted = Counter()
         # XLA compile/cache plane (crypto/backend.py instrumentation):
         # first-call compiles are the 100-160s tax the warm cache exists
         # to kill; a recompile on a warm entry means SHAPE DRIFT — the
@@ -329,6 +335,9 @@ class Registry:
             "peers": self.peers.value,
             "p2p_msgs_sent": self.msgs_sent.value,
             "p2p_msgs_received": self.msgs_received.value,
+            "switch_reconnect_attempts":
+                self.switch_reconnect_attempts.value,
+            "switch_peers_evicted": self.switch_peers_evicted.value,
             "device_step_seconds": self.device_step_hist.snapshot(),
             "batch_occupancy": self.batch_occupancy_hist.snapshot(),
             "round_seconds": self.round_seconds_hist.snapshot(),

@@ -119,6 +119,31 @@ def test_smoke_scenario(name):
     assert r.ok, f"{name} failed: {r.failures}"
 
 
+def test_seed_range_sweep_of_a_smoke_scenario(tmp_path):
+    """The soak path in miniature: a 3-seed `run_sweep` completes with
+    zero failures, zero breaches, and a chaos-ledger entry whose
+    per-scenario rate plugs into the bench-ledger delta machinery."""
+    from tendermint_tpu.scenarios import parse_seed_range, run_sweep
+    from tendermint_tpu.utils import ledger as ledgermod
+
+    seeds = parse_seed_range("0:3")
+    assert seeds == [0, 1, 2]
+    ledger_path = str(tmp_path / "ledger.jsonl")
+    out = run_sweep(["device-wrong-answer"], seeds,
+                    artifacts=str(tmp_path), ledger_path=ledger_path)
+    cfg = out["summary"]["configs"]["device-wrong-answer"]
+    assert cfg["runs"] == 3
+    assert cfg["failures"] == 0 and cfg["breaches"] == 0
+    assert cfg["runs_per_sec"] > 0
+    assert len(out["results"]) == 3
+    entries = ledgermod.load(ledger_path)
+    assert len(entries) == 1
+    rate, unit = ledgermod.rate_of(
+        "device-wrong-answer",
+        entries[0]["configs"]["device-wrong-answer"])
+    assert rate and rate > 0 and unit == "runs_per_sec"
+
+
 # -- CLI ------------------------------------------------------------------
 
 def test_cli_chaos_list(capsys):

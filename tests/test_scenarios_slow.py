@@ -18,8 +18,10 @@ STRESS = sorted(n for n, sc in SCENARIOS.items() if not sc.smoke)
 
 
 def test_stress_catalog_is_what_we_think():
-    assert STRESS == ["crash-restart-storm", "partial-commit-replay",
-                     "partition-heal", "stale-commit-replay"]
+    assert STRESS == ["crash-restart-storm", "device-storm-partition",
+                      "equivocation-crash-restart", "partial-commit-replay",
+                      "partition-heal", "partition-heal-25",
+                      "stale-commit-replay", "stale-replay-partition"]
 
 
 @pytest.mark.parametrize("name", STRESS)

@@ -1,0 +1,283 @@
+"""Self-healing p2p layer: jittered-backoff reconnect, misbehavior
+scoring with temporary bans, heal-storm peer-cap enforcement, and the
+switch's thread/peer bookkeeping under churn.
+
+Covers the reconnect semantics split (`RECONNECT_MAX_ATTEMPTS` attempt
+cap vs `reconnect_backoff_max_s` SECONDS ceiling — the old single
+`RECONNECT_BACKOFF_MAX=16` constant was consumed as an attempt count
+while its name meant a sleep cap, so neither limit held).
+"""
+
+import random
+import socket
+import threading
+import time
+
+import pytest
+
+from tendermint_tpu.config import P2PConfig
+from tendermint_tpu.p2p.switch import (SwitchError, backoff_delay,
+                                       connect_switches, make_switch)
+from tendermint_tpu.p2p.types import ChannelDescriptor, NetAddress
+from tendermint_tpu.p2p.peer import Reactor
+from tendermint_tpu.utils.metrics import REGISTRY
+
+
+def _wait_for(cond, timeout=10.0, step=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(step)
+    return False
+
+
+class _NullReactor(Reactor):
+    def get_channels(self):
+        return [ChannelDescriptor(id=0x60)]
+
+
+def _cfg(**overrides) -> P2PConfig:
+    kw = dict(laddr="", pex=False, dial_timeout_s=1.0)
+    kw.update(overrides)
+    return P2PConfig(**kw)
+
+
+def _dead_addr() -> NetAddress:
+    """An address nothing listens on: bind, grab the port, close."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return NetAddress("tcp", "127.0.0.1", port)
+
+
+# -- backoff schedule --------------------------------------------------------
+
+def test_backoff_doubles_from_base_and_caps_in_seconds():
+    rng = random.Random(1)
+    delays = [backoff_delay(a, rng, base_s=1.0, max_s=8.0,
+                            jitter_frac=0.0) for a in range(6)]
+    assert delays == [1.0, 2.0, 4.0, 8.0, 8.0, 8.0]
+
+
+def test_backoff_jitter_stays_within_bounds():
+    rng = random.Random(7)
+    for attempt in range(8):
+        capped = min(0.5 * 2.0 ** attempt, 16.0)
+        for _ in range(200):
+            d = backoff_delay(attempt, rng, base_s=0.5, max_s=16.0,
+                              jitter_frac=0.2)
+            assert capped * 0.8 <= d <= capped * 1.2
+
+
+def test_backoff_is_deterministic_for_a_seeded_rng():
+    a = [backoff_delay(i, random.Random(42)) for i in range(5)]
+    b = [backoff_delay(i, random.Random(42)) for i in range(5)]
+    assert a == b
+
+
+# -- reconnect loop (fake clock via the _sleep hook) ------------------------
+
+def test_reconnect_gives_up_after_max_attempts():
+    """The attempt cap is a real limit: a persistent peer that never
+    comes back gets exactly `reconnect_max_attempts` redials, each
+    preceded by a backoff sleep from the schedule."""
+    sw = make_switch("net", {"r": _NullReactor()},
+                     _cfg(reconnect_max_attempts=4,
+                          reconnect_backoff_base_s=0.25,
+                          reconnect_backoff_max_s=1.0,
+                          reconnect_jitter_frac=0.2))
+    sleeps: list[float] = []
+    sw._sleep = lambda d: sleeps.append(d)      # fake clock: no waiting
+    before = REGISTRY.switch_reconnect_attempts.value
+    try:
+        sw._schedule_reconnect(_dead_addr())
+        assert _wait_for(lambda: len(sleeps) == 4)
+        time.sleep(0.3)                         # would-be 5th attempt
+        assert len(sleeps) == 4
+        assert REGISTRY.switch_reconnect_attempts.value - before == 4
+        for attempt, d in enumerate(sleeps):
+            capped = min(0.25 * 2.0 ** attempt, 1.0)
+            assert capped * 0.8 <= d <= capped * 1.2, (attempt, d)
+    finally:
+        sw.stop()
+
+
+def test_reconnect_loop_stops_once_peer_is_back():
+    """The backoff loop exits when the persistent addr's peer is
+    already registered (a racing dial/accept won) instead of dialing a
+    connected peer forever."""
+    sw1 = make_switch("net", {"r": _NullReactor()}, _cfg())
+    sw2 = make_switch("net", {"r": _NullReactor()}, _cfg())
+    sw1.start(); sw2.start()
+    try:
+        p12, _ = connect_switches(sw1, sw2)
+        sw1._persistent_addrs[p12.id] = _dead_addr()
+        sleeps: list[float] = []
+        sw1._sleep = lambda d: sleeps.append(d)
+        before = REGISTRY.switch_reconnect_attempts.value
+        sw1._schedule_reconnect(sw1._persistent_addrs[p12.id])
+        assert _wait_for(lambda: len(sleeps) >= 1)
+        time.sleep(0.3)
+        # slept once, then saw the peer registered and bailed: no dial
+        assert REGISTRY.switch_reconnect_attempts.value == before
+        assert len(sleeps) == 1
+    finally:
+        sw1.stop(); sw2.stop()
+
+
+# -- heal storm: the peer cap holds under simultaneous inbound --------------
+
+def test_heal_storm_never_overshoots_max_num_peers():
+    """max_num_peers is enforced atomically with the peer-table insert:
+    a storm of simultaneous inbound handshakes (more dialers than
+    slots) must never overshoot the cap, even transiently."""
+    n_dialers, cap = 12, 4
+    hub = make_switch("net", {"r": _NullReactor()},
+                      _cfg(laddr="tcp://127.0.0.1:0", max_num_peers=cap))
+    dialers = [make_switch("net", {"r": _NullReactor()}, _cfg())
+               for _ in range(n_dialers)]
+    hub.start()
+    for d in dialers:
+        d.start()
+    overshoot = {"max": 0}
+    stop = threading.Event()
+
+    def sample():
+        while not stop.is_set():
+            n = hub.n_peers()
+            if n > overshoot["max"]:
+                overshoot["max"] = n
+            time.sleep(0.001)
+
+    threading.Thread(target=sample, daemon=True).start()
+    try:
+        addr = hub._listener.addr
+        for d in dialers:
+            d.dial_peer_async(addr)
+        assert _wait_for(lambda: hub.n_peers() == cap)
+        time.sleep(0.5)                 # let the refused stragglers race
+        assert overshoot["max"] <= cap
+        assert hub.n_peers() == cap
+    finally:
+        stop.set()
+        hub.stop()
+        for d in dialers:
+            d.stop()
+
+
+# -- misbehavior scoring + temporary bans -----------------------------------
+
+def test_misbehavior_strikes_accumulate_to_ban_and_expire():
+    cfg = _cfg(misbehavior_ban_score=3.0, misbehavior_ban_window_s=0.8)
+    sw1 = make_switch("net", {"r": _NullReactor()}, cfg)
+    sw2 = make_switch("net", {"r": _NullReactor()}, _cfg())
+    sw1.start(); sw2.start()
+    evicted_before = REGISTRY.switch_peers_evicted.value
+    try:
+        connect_switches(sw1, sw2)
+        pid = sw2.node_info.id
+        assert not sw1.report_misbehavior(pid, "strike one")
+        assert not sw1.report_misbehavior(pid, "strike two")
+        assert sw1.misbehavior_score(pid) == 2.0
+        assert sw1.get_peer(pid) is not None        # not banned yet
+        # third strike crosses the line: evicted + banned
+        assert sw1.report_misbehavior(pid, "strike three")
+        assert sw1.is_banned(pid)
+        assert sw1.get_peer(pid) is None
+        assert REGISTRY.switch_peers_evicted.value - evicted_before == 1
+        assert pid in sw1.banned_peers()
+        # redial while banned is refused on the handshake
+        assert _wait_for(lambda: sw2.n_peers() == 0)
+        with pytest.raises(SwitchError, match="banned"):
+            connect_switches(sw2, sw1)
+        # the ban self-expires after its window, then the peer may rejoin
+        assert _wait_for(lambda: not sw1.is_banned(pid), timeout=3.0)
+        connect_switches(sw2, sw1)
+        assert sw1.get_peer(pid) is not None
+        # strikes were cleared by the served ban, not carried forever
+        assert sw1.misbehavior_score(pid) == 0.0
+    finally:
+        sw1.stop(); sw2.stop()
+
+
+def test_proven_lie_bans_immediately():
+    """`ban=True` (a proven protocol lie, e.g. a failed commit check)
+    skips the strike accumulation and bans on the first report."""
+    sw1 = make_switch("net", {"r": _NullReactor()},
+                      _cfg(misbehavior_ban_window_s=30.0))
+    sw2 = make_switch("net", {"r": _NullReactor()}, _cfg())
+    sw1.start(); sw2.start()
+    try:
+        connect_switches(sw1, sw2)
+        pid = sw2.node_info.id
+        assert sw1.report_misbehavior(pid, "bad block", ban=True)
+        assert sw1.is_banned(pid)
+        assert sw1.get_peer(pid) is None
+    finally:
+        sw1.stop(); sw2.stop()
+
+
+def test_ban_check_is_atomic_with_peer_insert():
+    """A handshake that passed the pre-insert ban check must not
+    register the peer if a ban landed meanwhile: the post-insert
+    re-check evicts it (the re-admitted-while-banned race)."""
+    sw1 = make_switch("net", {"r": _NullReactor()}, _cfg())
+    sw2 = make_switch("net", {"r": _NullReactor()}, _cfg())
+    sw1.start(); sw2.start()
+    try:
+        pid = sw2.node_info.id
+        orig_handshake = sw1._handshake
+
+        def racing_handshake(conn):
+            info = orig_handshake(conn)
+            # the report lands between handshake completion and insert
+            sw1.report_misbehavior(pid, "raced lie", ban=True)
+            return info
+
+        sw1._handshake = racing_handshake
+        with pytest.raises(SwitchError, match="banned"):
+            connect_switches(sw2, sw1)
+        assert sw1.get_peer(pid) is None
+    finally:
+        sw1.stop(); sw2.stop()
+
+
+# -- bookkeeping under churn ------------------------------------------------
+
+def test_dial_threads_are_reaped_not_leaked():
+    """Soak runs dial thousands of times; the helper-thread list must
+    reap finished threads instead of growing one entry per attempt."""
+    sw = make_switch("net", {"r": _NullReactor()}, _cfg())
+    addr = _dead_addr()
+    for _ in range(40):
+        sw.dial_peer_async(addr)
+        time.sleep(0.005)
+    assert _wait_for(
+        lambda: sum(t.is_alive() for t in sw._threads) == 0)
+    sw.dial_peer_async(addr)        # one more append triggers a reap
+    with sw._threads_lock:
+        assert len(sw._threads) <= 2
+    sw.stop()
+
+
+def test_stale_death_notification_spares_reconnected_successor():
+    """Peer removal is identity-checked: a late death notification from
+    a REPLACED connection's reader thread must not evict the healthy
+    successor that reconnected under the same peer id."""
+    sw1 = make_switch("net", {"r": _NullReactor()}, _cfg())
+    sw2 = make_switch("net", {"r": _NullReactor()}, _cfg())
+    sw1.start(); sw2.start()
+    try:
+        old, _ = connect_switches(sw1, sw2)
+        sw1.stop_peer_gracefully(old)
+        assert _wait_for(lambda: sw2.n_peers() == 0)
+        fresh, _ = connect_switches(sw1, sw2)
+        assert fresh is not old and fresh.id == old.id
+        # the old connection's reader finally reports its death
+        sw1.stop_peer_for_error(old, ConnectionError("stale reader"))
+        assert sw1.get_peer(old.id) is fresh
+        assert sw2.n_peers() == 1
+    finally:
+        sw1.stop(); sw2.stop()
