@@ -245,17 +245,20 @@ def _build_bench_chain(n_vals: int, n_blocks: int, txs_per_block: int = 1):
 # -- on-disk fixture cache --------------------------------------------------
 # The expensive, deterministic parts of the two-pass builder (the kvstore
 # app-hash loop and the 10M-lane device signing) are cached keyed on
-# (n_vals, n_blocks, payload, time_salt); pass-1 block assembly always
-# re-runs (the objects are cheap to build, expensive to serialize).  A
-# cached sig matrix is native-spot-checked against freshly rebuilt
-# templates before use — any inconsistency evicts the entry and rebuilds.
+# (n_vals, n_blocks, payload); pass-1 block assembly always re-runs (the
+# objects are cheap to build, expensive to serialize).  A cached sig
+# matrix is native-spot-checked against freshly rebuilt templates before
+# use — any inconsistency evicts the entry and rebuilds.  Salted retries
+# do NOT key the cache: a retry re-signs ~1/_RESALT_STRIDE of the
+# seen-commit lanes from the in-process base fixture (see
+# `_resalt_pass2`) instead of rebuilding, so the blocks — and the app
+# hashes — are identical across salts.
 
-def _fixture_cache_file(n_vals: int, n_blocks: int, payload: int,
-                        time_salt: int) -> str:
+def _fixture_cache_file(n_vals: int, n_blocks: int, payload: int) -> str:
     d = os.environ.get("TM_BENCH_CACHE_DIR",
                        "/tmp/tendermint_tpu_bench_cache")
     return os.path.join(
-        d, f"chain_v{n_vals}_b{n_blocks}_p{payload}_s{time_salt}.npz")
+        d, f"chain_v{n_vals}_b{n_blocks}_p{payload}.npz")
 
 
 def _fixture_cache_load(path: str):
@@ -294,11 +297,30 @@ def _fixture_cache_save(path: str, hashes: list, sigs) -> None:
         log(f"[fixture] cache save failed ({e}); continuing uncached")
 
 
-def _build_bench_chain_fast(n_vals: int, n_blocks: int,
-                            payload: int = 12 * 1024,
-                            time_salt: int = 0,
-                            _use_cache: bool = True):
-    """Two-pass fixture for the NAMED 100k-block scale (BASELINE config 3).
+# in-process base-fixture memo, keyed (n_vals, n_blocks, payload): the
+# blocks/bids/sigs/templates a salted RETRY reuses.  A degraded-run
+# retry used to rebuild the whole fixture (~170s at the named scale in
+# BENCH_r05); with the memo it re-signs ~1% of lanes in seconds.
+_FIXTURE_MEMO: dict = {}
+_RESALT_STRIDE = 100
+
+
+def _resalt_plan(n_blocks: int, salt: int) -> tuple[int, int]:
+    """(stride, bump): a salted fixture bumps the seen-commit ROUND to
+    `salt` for every height with h % stride == bump.  stride shrinks to
+    n_blocks for tiny quick fixtures so at least one block always bumps,
+    and at the named scale every 625-block window contains >= 6 bumped
+    blocks — each window's verify upload is byte-distinct, so the dev
+    tunnel's result cache cannot flatter a retry."""
+    stride = min(_RESALT_STRIDE, max(1, n_blocks))
+    return stride, salt % stride
+
+
+def _fixture_build_base(n_vals: int, n_blocks: int, payload: int,
+                        _use_cache: bool = True) -> dict:
+    """Two-pass BASE fixture for the NAMED 100k-block scale (BASELINE
+    config 3) — salt-independent; salted variants derive from it via
+    `_resalt_pass2`.
 
     The small builder host-signs every commit sequentially (~6k sigs/s
     on one core), which is what capped r4's bench at 6,540 of the named
@@ -333,8 +355,7 @@ def _build_bench_chain_fast(n_vals: int, n_blocks: int,
     from tendermint_tpu.abci.app import create_app
 
     chain_id = "bench-chain"
-    t_build0 = time.perf_counter()
-    cache_file = _fixture_cache_file(n_vals, n_blocks, payload, time_salt)
+    cache_file = _fixture_cache_file(n_vals, n_blocks, payload)
     cached = _fixture_cache_load(cache_file) if _use_cache else None
     privs, vs = make_validators(n_vals)
     gen = make_genesis(chain_id, privs)
@@ -377,7 +398,7 @@ def _build_bench_chain_fast(n_vals: int, n_blocks: int,
                        Commit(block_id=last_block_id,
                               precommits=unsigned_slots))
         block = Block.make(chain_id=chain_id, height=h,
-                           time_ns=1_000_000_000 + h + time_salt,
+                           time_ns=1_000_000_000 + h,
                            txs=txs_for(h),
                            last_commit=last_commit,
                            last_block_id=last_block_id,
@@ -426,10 +447,8 @@ def _build_bench_chain_fast(n_vals: int, n_blocks: int,
             gc.enable()
             del blocks, bids
             gc.collect()
-            return _build_bench_chain_fast(n_vals, n_blocks,
-                                           payload=payload,
-                                           time_salt=time_salt,
-                                           _use_cache=False)
+            return _fixture_build_base(n_vals, n_blocks, payload,
+                                       _use_cache=False)
         log(f"[fixture] pass 2: {n_blocks * n_vals} sig lanes loaded "
             "from cache (spot-check ok)")
     if sigs is None:
@@ -437,21 +456,7 @@ def _build_bench_chain_fast(n_vals: int, n_blocks: int,
             f"seen-commit lanes...")
         prev = cb._current
         be = cb.set_backend("tpu")
-        ch = 655                       # 65,500-lane device chunks
-        val_idx = np.tile(np.arange(n_vals, dtype=np.int32), ch)
-        sigs = np.zeros((n_blocks * n_vals, 64), np.uint8)
-        for off in range(0, n_blocks, ch):
-            hi = min(off + ch, n_blocks)
-            tmpl = templates[off:hi]
-            if hi - off < ch:      # pad template rows: keep ONE jit shape
-                tmpl = np.concatenate(
-                    [tmpl, np.zeros((ch - (hi - off), tmpl.shape[1]),
-                                    np.uint8)])
-            k = (hi - off) * n_vals
-            sigs[off * n_vals:hi * n_vals] = be.sign_grouped_templated(
-                seeds, val_idx[:k],
-                np.repeat(np.arange(hi - off, dtype=np.int32), n_vals),
-                tmpl)
+        sigs = _device_sign_templated(be, seeds, n_vals, templates)
         cb._current = prev
         for i in np.random.default_rng(3).integers(0, len(sigs), 16):
             v = int(i) % n_vals
@@ -463,23 +468,133 @@ def _build_bench_chain_fast(n_vals: int, n_blocks: int,
         log(f"[fixture] pass 2 done in {time.perf_counter() - t0:.1f}s")
         if _use_cache:
             _fixture_cache_save(cache_file, hashes, sigs)
+    gc.enable()
+    return {"n_vals": n_vals, "n_blocks": n_blocks, "chain_id": chain_id,
+            "privs": privs, "vs": vs, "gen": gen, "blocks": blocks,
+            "bids": bids, "sigs": sigs, "bh": bh, "ph": ph, "pt": pt,
+            "seeds": seeds,
+            "pubs": [p.pub_key.bytes_ for p in privs],
+            "present": np.ones(n_vals, dtype=bool),
+            "from_cache": cached is not None}
 
+
+def _device_sign_templated(be, seeds, n_vals: int, templates) -> "object":
+    """Sign len(templates) x n_vals lanes on the device in fixed-shape
+    chunks (655 template rows -> 65,500 lanes at 100 validators), row
+    padding keeping every chunk on ONE jit shape — the base pass 2 and
+    the salted re-sign share this, so a retry never compiles."""
+    import numpy as np
+    nb = len(templates)
+    ch = 655                       # 65,500-lane device chunks
+    val_idx = np.tile(np.arange(n_vals, dtype=np.int32), ch)
+    sigs = np.zeros((nb * n_vals, 64), np.uint8)
+    for off in range(0, nb, ch):
+        hi = min(off + ch, nb)
+        tmpl = templates[off:hi]
+        if hi - off < ch:      # pad template rows: keep ONE jit shape
+            tmpl = np.concatenate(
+                [tmpl, np.zeros((ch - (hi - off), tmpl.shape[1]),
+                                np.uint8)])
+        k = (hi - off) * n_vals
+        sigs[off * n_vals:hi * n_vals] = be.sign_grouped_templated(
+            seeds, val_idx[:k],
+            np.repeat(np.arange(hi - off, dtype=np.int32), n_vals),
+            tmpl)
+    return sigs
+
+
+def _resalt_pass2(memo: dict, salt: int):
+    """Re-run pass 2 against the CACHED pass-1 blocks for a salted
+    retry: bump the seen-commit round to `salt` for the ~1/stride of
+    heights `_resalt_plan` selects and device re-sign just those lanes.
+    Blocks, app hashes, and every other commit are untouched — the
+    retry chain is byte-distinct per window (templates and sigs differ
+    wherever a bumped block lands) at ~1% of the full pass-2 cost.
+    Returns the re-signed uint8[nb * n_vals, 64] matrix in bumped-height
+    order."""
+    import numpy as np
+    from tendermint_tpu.crypto import backend as cb
+    from tendermint_tpu.crypto import native
+    from tendermint_tpu.crypto import pure_ed25519 as ref
+    from tendermint_tpu.types import canonical
+    n_vals, n_blocks = memo["n_vals"], memo["n_blocks"]
+    stride, bump = _resalt_plan(n_blocks, salt)
+    hs = np.arange(1, n_blocks + 1, dtype=np.int64)
+    mask = hs % stride == bump
+    heights = hs[mask]
+    nb = len(heights)
+    log(f"[fixture] re-salt: device re-signing {nb * n_vals} lanes "
+        f"(round={salt}, {nb}/{n_blocks} blocks)...")
     t0 = time.perf_counter()
+    templates = canonical.batch_sign_bytes(
+        memo["chain_id"],
+        np.full(nb, canonical.TYPE_PRECOMMIT, np.int64), heights,
+        np.full(nb, salt, dtype=np.int64),
+        memo["bh"][mask], memo["ph"][mask], memo["pt"][mask])
+    prev = cb._current
+    be = cb.set_backend("tpu")
+    sigs = _device_sign_templated(be, memo["seeds"], n_vals, templates)
+    cb._current = prev
+    vfy = native.verify_one if native.AVAILABLE else ref.verify
+    for i in np.random.default_rng(5).integers(0, len(sigs), 8):
+        v = int(i) % n_vals
+        if not vfy(memo["pubs"][v], templates[int(i) // n_vals].tobytes(),
+                   sigs[int(i)].tobytes()):
+            raise RuntimeError(f"re-salted fixture lane {i} invalid")
+    log(f"[fixture] re-salt pass 2 done in "
+        f"{time.perf_counter() - t0:.1f}s")
+    return sigs
+
+
+def _build_bench_chain_fast(n_vals: int, n_blocks: int,
+                            payload: int = 12 * 1024,
+                            salt: int = 0,
+                            _use_cache: bool = True):
+    """Fixture front door: build (or reuse) the salt-independent base
+    via `_fixture_build_base`, derive the salted variant via
+    `_resalt_pass2` when salt != 0, and assemble the CompactCommit
+    chain.  The memo makes a degraded-run RETRY cost seconds (partial
+    re-sign + commit assembly) instead of the ~170s full rebuild
+    BENCH_r05 paid per attempt."""
+    import gc
+    import numpy as np
     from tendermint_tpu.types.block import CompactCommit
+    t_build0 = time.perf_counter()
+    key = (n_vals, n_blocks, payload)
+    memo = _FIXTURE_MEMO.get(key)
+    memoized = memo is not None
+    if memo is None:
+        memo = _fixture_build_base(n_vals, n_blocks, payload,
+                                   _use_cache=_use_cache)
+        _FIXTURE_MEMO[key] = memo
+    bump_sigs = _resalt_pass2(memo, salt) if salt else None
+    stride, bump = _resalt_plan(n_blocks, salt)
+    t0 = time.perf_counter()
+    blocks, bids, sigs = memo["blocks"], memo["bids"], memo["sigs"]
     # seen commits in the ARRAY-NATIVE form (types.block.CompactCommit):
     # rows of the signed matrix slice straight into verify lanes — the
     # Vote-object form costs ~5 GB of heap and ~45s of construction at
     # 10M votes, and its fields would be re-flattened right back into
     # these arrays by commit_verify_lanes
-    present = np.ones(n_vals, dtype=bool)
+    present = memo["present"]
     chain = []
+    gc.disable()       # n_blocks long-lived tuples; re-enabled below
+    j = 0
     for h in range(1, n_blocks + 1):
-        base = (h - 1) * n_vals
-        chain.append((blocks[h - 1], None,
-                      CompactCommit(block_id=bids[h - 1], height_=h,
-                                    round_=0,
-                                    sigs=sigs[base:base + n_vals],
-                                    present=present)))
+        if salt and h % stride == bump:
+            cc = CompactCommit(block_id=bids[h - 1], height_=h,
+                               round_=salt,
+                               sigs=bump_sigs[j * n_vals:
+                                              (j + 1) * n_vals],
+                               present=present)
+            j += 1
+        else:
+            base = (h - 1) * n_vals
+            cc = CompactCommit(block_id=bids[h - 1], height_=h,
+                               round_=0,
+                               sigs=sigs[base:base + n_vals],
+                               present=present)
+        chain.append((blocks[h - 1], None, cc))
     # the fixture is permanent for the whole run: freeze it OUT of the
     # collector before re-enabling — otherwise every gen-2 collection
     # during the replay scans the ~n_blocks*n_vals vote objects
@@ -491,9 +606,9 @@ def _build_bench_chain_fast(n_vals: int, n_blocks: int,
     tracing.RECORDER.record(
         "bench.fixture_build", tracing._EPOCH_T0 + t_build0,
         time.perf_counter() - t_build0,
-        {"n_vals": n_vals, "n_blocks": n_blocks, "salt": time_salt,
-         "cached": cached is not None})
-    return privs, vs, gen, chain
+        {"n_vals": n_vals, "n_blocks": n_blocks, "salt": salt,
+         "cached": memo["from_cache"], "resalt": bool(salt and memoized)})
+    return memo["privs"], memo["vs"], memo["gen"], chain
 
 
 # ---------------------------------------------------------------------------
@@ -541,17 +656,25 @@ def config0_cpu_replay(quick: bool) -> dict:
     return res
 
 
-def config3_fastsync_cpu_anchor(n_blocks: int) -> dict:
+def config3_fastsync_cpu_anchor(n_blocks: int, n_vals: int = 100) -> dict:
     """The same 100-validator replay pipeline on the single-threaded
     native backend — the honest CPU baseline for the north star."""
     from tendermint_tpu.crypto import native as native_mod
     from tendermint_tpu.crypto import backend as cb
 
+    if not native_mod.AVAILABLE:
+        # containers without the native library (the CI quick smoke)
+        # anchor on the pure-python scalar backend instead: same replay,
+        # much slower anchor — only the healthy-multiple gate cares
+        # about the absolute rate, and that gate is full-scale-only
+        return _replay_chain(n_vals=n_vals, n_blocks=n_blocks,
+                             backend="python", window=64)
+
     class _Scalar(native_mod.NativeBackend):
         def __init__(self):
             super().__init__(workers=1)
     cb.register("native-scalar", _Scalar)
-    return _replay_chain(n_vals=100, n_blocks=n_blocks,
+    return _replay_chain(n_vals=n_vals, n_blocks=n_blocks,
                          backend="native-scalar", window=64)
 
 
@@ -767,11 +890,14 @@ def config2_merkle_batch(quick: bool) -> dict:
             "blocks": B, "txs": T}
 
 
+_REPLAY_SEQ = __import__("itertools").count()
+
+
 def _replay_chain(n_vals: int, n_blocks: int, backend: str,
                   window: int | None = None,
                   target_lanes: int = 32768,
                   payload: int = 12 * 1024,
-                  time_salt: int = 0) -> dict:
+                  salt: int = 0) -> dict:
     """Shared replay pipeline: batched commit verify + part re-hash +
     apply, identical to BlockchainReactor._sync_step minus networking.
 
@@ -780,19 +906,22 @@ def _replay_chain(n_vals: int, n_blocks: int, backend: str,
     device batch for window k+1, and the main thread applies window k —
     host packing, device verification, and host ABCI/store work all
     overlap (the reactor's verify-ahead sync loop, widened one stage), so
-    throughput is max(stage) instead of their sum.
+    throughput is max(stage) instead of their sum.  The host stages are
+    window-vectorized so they actually get out of each other's way under
+    the GIL: prep assembles all lanes in one numpy pass
+    (`window_commit_lanes`), apply runs the window through
+    `execution.apply_window` (one app-lock hold, one state save), and
+    the per-replay `overlap_fraction` lands in the result dict.
     """
     import queue as _queue
     import threading
-    import numpy as np
     from tendermint_tpu.crypto import backend as cb
     from tendermint_tpu.state import execution
     from tendermint_tpu.state.state import get_state
     from tendermint_tpu.proxy import ClientCreator
     from tendermint_tpu.types import BlockID
-    from tendermint_tpu.types.validator import (CommitPowerError,
-                                                CommitSignatureError,
-                                                merge_commit_lanes)
+    from tendermint_tpu.types.validator import (window_commit_lanes,
+                                                window_tally_check)
     from tendermint_tpu.utils.db import MemDB
 
     if window is None:
@@ -805,7 +934,7 @@ def _replay_chain(n_vals: int, n_blocks: int, backend: str,
         # including config3's 128-block CPU anchor, so the anchor replays
         # the SAME chain shape as the device run it normalizes
         privs, vs, gen, chain = _build_bench_chain_fast(
-            n_vals, n_blocks, payload=payload, time_salt=time_salt)
+            n_vals, n_blocks, payload=payload, salt=salt)
     else:
         privs, vs, gen, chain = _build_bench_chain(n_vals, n_blocks)
     cb.set_backend(backend)
@@ -819,8 +948,13 @@ def _replay_chain(n_vals: int, n_blocks: int, backend: str,
     chain_id = state.chain_id
     set_key, pubs_mat = vals.set_key(), vals.pubs_matrix()
     total_power = vals.total_voting_power()
+    # window keys are namespaced per replay (r<seq>.<win>): the doctor
+    # groups spans by window arg across the WHOLE recorder, and bare
+    # indices collide between attempts/configs, merging unrelated spans
+    # into one bogus mega-window
+    tag = f"r{next(_REPLAY_SEQ)}"
     from concurrent.futures import ThreadPoolExecutor
-    prep_pool = ThreadPoolExecutor(4)
+    prep_pool = ThreadPoolExecutor(4, thread_name_prefix="bench-prep")
 
     def _prep(blocks, win=None):
         """Stage 1: part-set re-hash + lane assembly (host).  Hashing
@@ -830,27 +964,28 @@ def _replay_chain(n_vals: int, n_blocks: int, backend: str,
         end-to-end.  Lanes are the TEMPLATED form: ~1 message template
         per block plus per-lane (sig, validator index, template index) —
         the device assembles messages and gathers pubkeys itself, so the
-        host ships 72 B/lane instead of 228 B.
+        host ships 72 B/lane instead of 228 B.  Lane assembly is ONE
+        `window_commit_lanes` numpy pass — the old per-block
+        commit_verify_lanes loop was the prep stage's scalar tail.
 
         `win` is the replay window index; it rides every stage's span as
         the window= arg the attribution doctor groups by (the warm-up
         window stays unkeyed so its compile cost isn't misattributed to
         steady-state throughput)."""
-        wargs = {"window": win} if win is not None else {}
+        wargs = {"window": f"{tag}.{win}"} if win is not None else {}
         with tracing.span("bench.prep", blocks=len(blocks), **wargs):
-            items, lanes = [], []
             # partial thread-level overlap: the hashlib/merkle C calls
             # inside make_part_set release the GIL (block encodes are
-            # cache-seeded), measured ~25% off the prep stage; lane
-            # assembly (pure Python) stays serial below
+            # cache-seeded), measured ~25% off the prep stage
             parts_list = list(prep_pool.map(
                 lambda b: b[0].make_part_set(), blocks))
-            for (block, _, seen), parts in zip(blocks, parts_list):
-                bid = BlockID(block.hash(), parts.header)
-                items.append((bid, block.height, seen, parts))
-                lanes.append(vals.commit_verify_lanes(chain_id, bid,
-                                                      block.height, seen))
-            templates, tmpl_idx, sigs, idxs = merge_commit_lanes(lanes)
+            items = [(BlockID(block.hash(), parts.header), block.height,
+                      seen, parts)
+                     for (block, _, seen), parts in zip(blocks, parts_list)]
+            (templates, tmpl_idx, sigs, idxs,
+             counts, tallied, foreign) = window_commit_lanes(
+                vals, chain_id, [(bid, h, c) for bid, h, c, _ in items])
+            tallies = (counts, tallied, foreign)
             prefetch = getattr(cb.get_backend(),
                                "prefetch_grouped_lanes", None)
             if prefetch is not None:
@@ -862,36 +997,30 @@ def _replay_chain(n_vals: int, n_blocks: int, backend: str,
                 # keeps telemetry and result trims keyed to real lanes
                 idxs, tmpl_idx, templates, sigs, n = prefetch(
                     idxs, tmpl_idx, templates, sigs)
-                return win, items, lanes, templates, tmpl_idx, sigs, idxs, n
-            return (win, items, lanes, templates, tmpl_idx, sigs, idxs,
+                return (win, items, tallies, templates, tmpl_idx, sigs,
+                        idxs, n)
+            return (win, items, tallies, templates, tmpl_idx, sigs, idxs,
                     len(idxs))
 
     def _dispatch(prepped):
         """Stage 2a: upload + queue the grouped device batch (async)."""
-        win, items, lanes, templates, tmpl_idx, sigs, idxs, n = prepped
-        wargs = {"window": win} if win is not None else {}
+        win, items, tallies, templates, tmpl_idx, sigs, idxs, n = prepped
+        wargs = {"window": f"{tag}.{win}"} if win is not None else {}
         with tracing.span("bench.dispatch", blocks=len(items), lanes=n,
                           **wargs):
             fut = cb.verify_grouped_templated_async(
                 set_key, pubs_mat, idxs, tmpl_idx, templates, sigs,
                 real_n=n)
-        return win, items, lanes, fut
+        return win, items, tallies, fut
 
-    def _collect(win, items, lanes, fut):
-        """Stage 2b: block on the device result + per-commit tallies."""
-        wargs = {"window": win} if win is not None else {}
+    def _collect(win, items, tallies, fut):
+        """Stage 2b: block on the device result + per-commit tallies
+        (vectorized — `window_tally_check` raises the same per-height
+        errors the per-block loop did)."""
+        wargs = {"window": f"{tag}.{win}"} if win is not None else {}
         with tracing.span("bench.verify", blocks=len(items), **wargs):
             ok = fut()
-            off = 0
-            for (bid, h, _, _), a in zip(items, lanes):
-                n = len(a[4])
-                if not ok[off:off + n].all():
-                    raise CommitSignatureError(
-                        h, int(np.argmin(ok[off:off + n])))
-                off += n
-                tallied = int(a[3].sum())
-                if not tallied * 3 > total_power * 2:
-                    raise CommitPowerError(h, tallied, total_power)
+            window_tally_check(items, ok, *tallies, total_power)
 
     def _verify(*prepped):
         _collect(*_dispatch(prepped))
@@ -926,8 +1055,8 @@ def _replay_chain(n_vals: int, n_blocks: int, backend: str,
 
         def drain_one():
             t = time.perf_counter()
-            win, items, lanes, fut = inflight.popleft()
-            _collect(win, items, lanes, fut)
+            win, items, tallies, fut = inflight.popleft()
+            _collect(win, items, tallies, fut)
             verify_seconds[0] += time.perf_counter() - t
             verified_q.put((win, items))
 
@@ -950,39 +1079,56 @@ def _replay_chain(n_vals: int, n_blocks: int, backend: str,
             verified_q.put(e)
 
     t0 = time.perf_counter()
-    threading.Thread(target=_prep_thread, daemon=True).start()
-    threading.Thread(target=_verify_thread, daemon=True).start()
     apply_seconds = 0.0
-    while True:
-        got = verified_q.get()
-        if got is None:
-            break
-        if isinstance(got, BaseException):
-            raise got
-        win, items = got
-        total_sigs += sum(c.num_sigs() for _, _, c, _ in items)
-        t = time.perf_counter()
-        wargs = {"window": win} if win is not None else {}
-        with tracing.span("bench.apply", blocks=len(items), **wargs):
-            for bid, h, c, parts in items:
-                block = chain[h - 1][0]
-                execution.apply_block(state, None, conns.consensus, block,
-                                      parts.header,
-                                      execution.MockMempool(),
-                                      check_last_commit=False)
-        apply_seconds += time.perf_counter() - t
-    dt = time.perf_counter() - t0
-    prep_pool.shutdown(wait=False)
+    try:
+        threading.Thread(target=_prep_thread, daemon=True).start()
+        threading.Thread(target=_verify_thread, daemon=True).start()
+        while True:
+            got = verified_q.get()
+            if got is None:
+                break
+            if isinstance(got, BaseException):
+                raise got
+            win, items = got
+            total_sigs += sum(c.num_sigs() for _, _, c, _ in items)
+            t = time.perf_counter()
+            wargs = {"window": f"{tag}.{win}"} if win is not None else {}
+            with tracing.span("bench.apply", blocks=len(items), **wargs):
+                # one app-lock hold + one state save for the whole
+                # window (save_every=0 is safe here: MemDB replay, no
+                # crash recovery to respect)
+                execution.apply_window(
+                    state, None, conns.consensus,
+                    [(chain[h - 1][0], parts.header)
+                     for _bid, h, _c, parts in items],
+                    execution.MockMempool(), check_last_commit=False,
+                    save_every=0)
+            apply_seconds += time.perf_counter() - t
+        dt = time.perf_counter() - t0
+    finally:
+        # wait=True: leaked "bench-prep" workers would steal cycles from
+        # every subsequent config/attempt in this process
+        prep_pool.shutdown(wait=True)
     assert state.last_block_height == n_blocks
     out = {"blocks_per_sec": n_blocks / dt, "sigs_per_sec": total_sigs / dt,
            "blocks": n_blocks, "validators": n_vals, "seconds": dt,
            "prep_seconds": round(prep_seconds[0], 2),
            "verify_seconds": round(verify_seconds[0], 2),
            "apply_seconds": round(apply_seconds, 2)}
+    try:
+        from tendermint_tpu.utils import attribution
+        rows = [r for r in attribution.window_attribution(
+                    tracing.RECORDER.snapshot())
+                if isinstance(r.get("window"), str)
+                and r["window"].startswith(tag + ".")]
+        out.update(attribution.overlap_summary(rows))
+    except Exception as e:   # telemetry must never fail the replay
+        log(f"[replay] overlap attribution failed: {e}")
     log(f"[replay] backend={backend}: {out['blocks_per_sec']:.1f} blocks/s "
         f"{out['sigs_per_sec']:.0f} sigs/s over {dt:.1f}s "
         f"(prep {out['prep_seconds']}s verify {out['verify_seconds']}s "
-        f"apply {out['apply_seconds']}s)")
+        f"apply {out['apply_seconds']}s overlap "
+        f"{out.get('overlap_fraction', 0.0):.2f})")
     return out
 
 
@@ -1027,9 +1173,12 @@ def config4_light_multichain(quick: bool) -> dict:
                 log("[config4] deadline too close for another fixture "
                     "build; reporting best attempt as degraded")
                 break
+            # the bar is 18x the scalar anchor, not the anchor itself
             log(f"[config4] degraded run "
-                f"({attempts[-1]['sigs_per_sec']:.0f} sigs/s vs anchor "
-                f"{scalar:.0f}); retrying on a fresh fixture")
+                f"({attempts[-1]['sigs_per_sec']:.0f} sigs/s = "
+                f"{attempts[-1]['sigs_per_sec'] / scalar:.1f}x anchor; "
+                f"healthy bar {healthy:.0f} = 18.0x); "
+                "retrying on a fresh fixture")
             attempts.append(_config4_attempt(quick, salt=salt))
     out = max(attempts, key=lambda r: r["sigs_per_sec"])
     out["attempts"] = len(attempts)
@@ -1038,6 +1187,10 @@ def config4_light_multichain(quick: bool) -> dict:
     # tries to land one good run
     out["attempt_rates"] = [round(a["sigs_per_sec"], 1) for a in attempts]
     out["degraded"] = bool(not quick and out["sigs_per_sec"] < healthy)
+    if not quick:
+        out["healthy_sigs_per_sec"] = round(healthy, 1)
+        out["healthy_multiple"] = 18.0
+        out["anchor_multiple"] = round(out["sigs_per_sec"] / scalar, 2)
     return out
 
 
@@ -1146,8 +1299,26 @@ def config3_fastsync(quick: bool) -> dict:
     # windows of 625 blocks, all hitting ONE jit shape (62,500 lanes and
     # 625 templates bucket to 65,536 / 1,024; an uneven tail whose
     # template count crossed the 512 bucket would recompile mid-run)
-    n_blocks = 326 if quick else 100_000
-    anchor = config3_fastsync_cpu_anchor(64 if quick else 128)
+    # quick mode is also the tier-1 CPU smoke; TM_BENCH_QUICK_BLOCKS /
+    # TM_BENCH_QUICK_VALS let CI shrink the chain below the defaults —
+    # on CPU the 100-key comb-table build alone runs ~10 minutes, so the
+    # smoke exercises the identical pipeline at toy scale instead
+    n_blocks = (int(os.environ.get("TM_BENCH_QUICK_BLOCKS", "326"))
+                if quick else 100_000)
+    n_vals = (int(os.environ.get("TM_BENCH_QUICK_VALS", "100"))
+              if quick else 100)
+    if not quick:
+        # kick off the persistent-cache pre-warm for the full-scale
+        # replay shapes NOW, so the ~2-min XLA compiles overlap the CPU
+        # anchor replay below instead of eating the first timed attempt
+        from tendermint_tpu.crypto import warmcompile
+        warmcompile.prewarm(
+            warmcompile.bench_config3_specs(n_vals=100, n_blocks=n_blocks,
+                                            window=625,
+                                            target_lanes=65536),
+            wait=False)
+    anchor = config3_fastsync_cpu_anchor(min(64, n_blocks) if quick
+                                         else 128, n_vals=n_vals)
     # the tunneled device's throughput swings widely between runs
     # (identical 100k replays measured 50s..275s in one session), so a
     # run below a healthy multiple of the scalar anchor retries on a
@@ -1160,10 +1331,10 @@ def config3_fastsync(quick: bool) -> dict:
     t_start = time.time()
     attempts = []
     for salt in (0, 7_777_777, 424_242):
-        res = _replay_chain(n_vals=100, n_blocks=n_blocks, backend="tpu",
-                            target_lanes=65536,
+        res = _replay_chain(n_vals=n_vals, n_blocks=n_blocks,
+                            backend="tpu", target_lanes=65536,
                             window=625 if not quick else None,
-                            time_salt=salt)
+                            salt=salt)
         attempts.append(res)
         if quick or res["sigs_per_sec"] >= healthy:
             break
@@ -1179,15 +1350,24 @@ def config3_fastsync(quick: bool) -> dict:
             log("[config3] deadline too close for another fixture build; "
                 "reporting best attempt as degraded")
             break
+        # the retry gate is the HEALTHY threshold (15x anchor), not the
+        # anchor itself — print both the bar and how far below it the
+        # attempt landed, so a degraded log reads as what it is
         log("[config3] device throughput looks degraded "
-            f"({res['sigs_per_sec']:.0f} sigs/s vs anchor "
-            f"{anchor['sigs_per_sec']:.0f}); retrying on a fresh fixture")
+            f"({res['sigs_per_sec']:.0f} sigs/s = "
+            f"{res['sigs_per_sec'] / anchor['sigs_per_sec']:.1f}x anchor; "
+            f"healthy bar {healthy:.0f} = 15.0x); "
+            "retrying on a re-salted fixture")
     res = max(attempts, key=lambda r: r["sigs_per_sec"])
     res["attempts"] = len(attempts)
     res["attempt_rates"] = [round(a["sigs_per_sec"], 1) for a in attempts]
     res["degraded"] = bool(not quick and res["sigs_per_sec"] < healthy)
     res["cpu_pipeline_sigs_per_sec"] = anchor["sigs_per_sec"]
     res["cpu_pipeline_blocks_per_sec"] = anchor["blocks_per_sec"]
+    res["healthy_sigs_per_sec"] = round(healthy, 1)
+    res["healthy_multiple"] = 15.0
+    res["anchor_multiple"] = round(
+        res["sigs_per_sec"] / anchor["sigs_per_sec"], 2)
     res["config"] = 3
     return res
 

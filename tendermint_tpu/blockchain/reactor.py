@@ -337,32 +337,45 @@ class BlockchainReactor(Reactor):
         if len(nxt) >= 2 and not self._stopped.is_set():
             self._lookahead = _Lookahead(
                 self.state.validators.copy(), chain_id, nxt)
-        applied = 0
+        commit_by_height = {h: c for _bid, h, c in items}
+        parts_by_height = {b.height: p for b, p in zip(window, parts_list)}
+
+        def _save_to_store(b, _psh):
+            # store-before-state is the crash-recovery discipline (the
+            # handshake covers store==state+1); but the pool advances
+            # only AFTER a successful apply so an in-process app/WAL
+            # fault re-fetches and re-applies instead of wedging the
+            # sync.
+            if self.store.height < b.height:
+                self.store.save_block(b, parts_by_height[b.height],
+                                      commit_by_height[b.height])
+
+        def _advance(b):
+            self.pool.pop(1)
+            REGISTRY.blocks_synced.inc()
+
+        def _valset_moved():
+            # validator set changed: the rest of the window was verified
+            # against a stale set — drop and re-verify
+            moved = self.state.validators.hash() != vals_hash
+            if moved:
+                log.info("valset changed mid-window; flushing",
+                         height=self.state.last_block_height)
+            return moved
+
         with tracing.span("fastsync.apply", first_height=window[0].height,
                           blocks=len(window)):
-            for b, parts, (bid, h, commit) in zip(window, parts_list,
-                                                  items):
-                # store-before-state is the crash-recovery discipline (the
-                # handshake covers store==state+1); but the pool advances
-                # only AFTER a successful apply so an in-process app/WAL
-                # fault re-fetches and re-applies instead of wedging the
-                # sync.
-                if self.store.height < b.height:
-                    self.store.save_block(b, parts, commit)
-                execution.apply_block(self.state, None, self.proxy, b,
-                                      parts.header,
-                                      execution.MockMempool(),
-                                      check_last_commit=False)
-                self.pool.pop(1)
-                REGISTRY.blocks_synced.inc()
-                applied += 1
-                new_hash = self.state.validators.hash()
-                if new_hash != vals_hash:
-                    # validator set changed: the rest of the window was
-                    # verified against a stale set — drop and re-verify
-                    log.info("valset changed mid-window; flushing",
-                             height=b.height)
-                    break
+            # the window-batched apply: per-block validate/exec/save
+            # discipline identical to apply_block (save_every=1 — a
+            # durable node must keep store <= state+1 for the
+            # handshake), but the app conn's lock is held once for the
+            # whole window instead of ~4 acquisitions per block
+            applied = execution.apply_window(
+                self.state, None, self.proxy,
+                [(b, p.header) for b, p in zip(window, parts_list)],
+                execution.MockMempool(), check_last_commit=False,
+                save_every=1, before_block=_save_to_store,
+                on_applied=_advance, stop_when=_valset_moved)
         # the window-boundary span: covers verify (or lookahead reuse)
         # through apply under one window=<first_height> key, which is
         # what the attribution profiler groups by

@@ -1,19 +1,25 @@
-"""Out-of-process verify-executable warmer.
+"""Out-of-process verify/sign-executable warmer.
 
 `TpuBackend._warm_verify_if_cold` spawns this module on a COLD validator
 set so the verify graph's XLA compile runs in a separate process — truly
 concurrent with the main process's comb-table build compile (in-process
 threads serialize inside XLA, measured r5) — and lands in the shared
 persistent compilation cache, which the main process then loads in
-seconds.
+seconds.  The bench uses the same mechanism to pre-warm config 3's full
+replay bucket shapes (`bench_config3_specs` + `prewarm`) before the
+timed run, overlapping the compiles with the CPU anchor replay.
 
 Usage: python -m tendermint_tpu.crypto.warmcompile '<json-spec>'
-spec: {"kind": "templated"|"plain", "vb": int, "shape": [..],
-       "cache_dir": str}
+spec: one spec object or a LIST of them, each
+  {"kind": "templated"|"plain"|"sign", "cache_dir": str, ...}
+  templated: {"vb": int, "shape": [b, tb, mlen]}
+  plain:     {"vb": int, "shape": [b, mlen]}
+  sign:      {"v": int, "shape": [b, tb, mlen]}   # v keys, EXACT (the
+             sign path buckets lanes/templates but not the key set)
 
-The last stdout line is a JSON report ({"kind", "compile_seconds"}) the
-parent parses into its XLA compile metrics — the compile happens in THIS
-process, so the parent's jax.monitoring listener never sees it.
+One stdout JSON line per spec ({"kind", "compile_seconds"}) which the
+parent parses into its XLA compile metrics — the compiles happen in THIS
+process, so the parent's jax.monitoring listener never sees them.
 """
 
 from __future__ import annotations
@@ -24,40 +30,114 @@ import sys
 import time
 
 
-def main() -> int:
-    spec = json.loads(sys.argv[1])
-    os.environ["TM_JAX_CACHE_DIR"] = spec["cache_dir"]
+def _bucket(n: int) -> int:
+    # mirrors crypto.backend._bucket without importing its module tree
+    b = 16
+    while b < n:
+        b *= 2
+    return b
+
+
+def bench_config3_specs(n_vals: int, n_blocks: int, window: int,
+                        target_lanes: int,
+                        cache_dir: str | None = None) -> list[dict]:
+    """The device shapes bench config 3 hits at full scale: the window's
+    templated verify bucket and the fixture builder's sign-chunk bucket
+    (`bench._device_sign_templated` chunks 655 template rows).  Derived
+    from the run parameters so a window/bucket change here cannot drift
+    from the bench — both sides compute, neither hardcodes."""
+    from tendermint_tpu.types.canonical import SIGN_BYTES_LEN
+    if cache_dir is None:
+        cache_dir = os.environ.get(
+            "TM_JAX_CACHE_DIR",
+            os.path.join(os.path.expanduser("~"), ".cache",
+                         "tendermint_tpu", "jax"))
+    window = max(1, min(n_blocks, window or (target_lanes // n_vals)))
+    sign_tmpls = min(655, n_blocks)
+    return [
+        {"kind": "templated", "vb": _bucket(n_vals),
+         "shape": [_bucket(window * n_vals), _bucket(window),
+                   SIGN_BYTES_LEN],
+         "cache_dir": cache_dir},
+        {"kind": "sign", "v": n_vals,
+         "shape": [_bucket(sign_tmpls * n_vals), _bucket(sign_tmpls),
+                   SIGN_BYTES_LEN],
+         "cache_dir": cache_dir},
+    ]
+
+
+def prewarm(specs: list[dict], wait: bool = False):
+    """Spawn the warmer subprocess over `specs`.  wait=False returns the
+    Popen immediately (the caller overlaps the compiles with other work
+    and never joins — the subprocess seeds the persistent cache and
+    exits); best-effort: any spawn failure is swallowed, the main
+    process then just pays the compile itself."""
+    import subprocess
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "tendermint_tpu.crypto.warmcompile",
+             json.dumps(specs)],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        if wait:
+            proc.wait(timeout=900)
+        return proc
+    except Exception:
+        return None
+
+
+def _warm_one(spec: dict) -> float:
     t0 = time.perf_counter()
     import jax.numpy as jnp
-    from tendermint_tpu.crypto.backend import _enable_compile_cache
     from tendermint_tpu.ops import ed25519 as dev
     from tendermint_tpu.ops.curve import COMB_DIGITS, COMB_WINDOWS, \
         _base_table
-    _enable_compile_cache()
-    vb = spec["vb"]
     base_tbl = jnp.asarray(_base_table())
-    ztbl = jnp.zeros((COMB_WINDOWS, COMB_DIGITS, vb, 3, 32), jnp.uint8)
-    zok = jnp.zeros((vb,), bool)
-    if spec["kind"] == "templated":
+    if spec["kind"] == "sign":
+        v = spec["v"]
         b, tb, mlen = spec["shape"]
-        out = dev.verify_grouped_templated_jit(
-            ztbl, zok, jnp.zeros((vb, 32), jnp.uint8),
+        out = dev.sign_grouped_templated_jit(
+            jnp.zeros((v, 32), jnp.uint8), jnp.zeros((v, 32), jnp.uint8),
+            jnp.zeros((v, 32), jnp.uint8),
             jnp.zeros((b,), jnp.int32), jnp.zeros((b,), jnp.int32),
-            jnp.zeros((tb, mlen), jnp.uint8),
-            jnp.zeros((b, 64), jnp.uint8), base_tbl)
+            jnp.zeros((tb, mlen), jnp.uint8), base_tbl)
     else:
-        b, mlen = spec["shape"]
-        out = dev.verify_grouped_jit(
-            ztbl, zok, jnp.zeros((b,), jnp.int32),
-            jnp.zeros((b, 32), jnp.uint8),
-            jnp.zeros((b, mlen), jnp.uint8),
-            jnp.zeros((b, 64), jnp.uint8), base_tbl)
+        vb = spec["vb"]
+        ztbl = jnp.zeros((COMB_WINDOWS, COMB_DIGITS, vb, 3, 32),
+                         jnp.uint8)
+        zok = jnp.zeros((vb,), bool)
+        if spec["kind"] == "templated":
+            b, tb, mlen = spec["shape"]
+            out = dev.verify_grouped_templated_jit(
+                ztbl, zok, jnp.zeros((vb, 32), jnp.uint8),
+                jnp.zeros((b,), jnp.int32), jnp.zeros((b,), jnp.int32),
+                jnp.zeros((tb, mlen), jnp.uint8),
+                jnp.zeros((b, 64), jnp.uint8), base_tbl)
+        else:
+            b, mlen = spec["shape"]
+            out = dev.verify_grouped_jit(
+                ztbl, zok, jnp.zeros((b,), jnp.int32),
+                jnp.zeros((b, 32), jnp.uint8),
+                jnp.zeros((b, mlen), jnp.uint8),
+                jnp.zeros((b, 64), jnp.uint8), base_tbl)
     out.block_until_ready()
-    # includes jax import + trace + compile: the parent treats the whole
-    # interval as compile-plane time (that is what the warmer displaced)
-    print(json.dumps({"kind": spec["kind"],
-                      "compile_seconds": round(time.perf_counter() - t0,
-                                               3)}))
+    return time.perf_counter() - t0
+
+
+def main() -> int:
+    specs = json.loads(sys.argv[1])
+    if isinstance(specs, dict):
+        specs = [specs]
+    if specs:
+        os.environ["TM_JAX_CACHE_DIR"] = specs[0]["cache_dir"]
+    from tendermint_tpu.crypto.backend import _enable_compile_cache
+    _enable_compile_cache()
+    for spec in specs:
+        # includes jax import + trace + compile on the first spec: the
+        # parent treats the whole interval as compile-plane time (that
+        # is what the warmer displaced)
+        secs = _warm_one(spec)
+        print(json.dumps({"kind": spec["kind"],
+                          "compile_seconds": round(secs, 3)}))
     return 0
 
 

@@ -118,6 +118,69 @@ def apply_block(state: State, event_cache, proxy_consensus, block,
     return state
 
 
+def apply_window(state: State, event_cache, proxy_consensus, items,
+                 mempool, tx_indexer=None, check_last_commit: bool = False,
+                 save_every: int = 1, before_block=None, on_applied=None,
+                 stop_when=None) -> int:
+    """Apply a verified fast-sync WINDOW of blocks (`items` =
+    [(block, part_set_header)]) — `apply_block` unrolled across the
+    window so the per-block overheads amortize:
+
+    - the consensus conn's lock is acquired ONCE for the whole window
+      (via `AppConn.batched`, when the conn offers it) instead of ~4
+      round-trips per block;
+    - with `save_every=0` state persistence collapses to one `save()` at
+      the window end — ONLY for ephemeral replays (the bench): a crash
+      mid-window leaves the store more than one block ahead of state,
+      which the handshake calls unrecoverable.  Durable nodes keep
+      `save_every=1`, the exact per-block discipline `apply_block` has.
+
+    Per-block semantics are otherwise identical — same validation, same
+    fail points, same mempool locking around each app Commit — so crash
+    tests and fault injection see the same sequence.  Hooks:
+    `before_block(block, psh)` runs pre-validate (the reactor saves to
+    the block store here, keeping store-before-state); `on_applied(block)`
+    runs after each block's commit; `stop_when()` (checked after
+    on_applied) ends the window early — the reactor stops when the
+    validator set changes, since later blocks were verified against a
+    stale set.  Returns the number of blocks applied.
+    """
+    batched = getattr(proxy_consensus, "batched", None)
+    if batched is None:
+        from contextlib import nullcontext
+        ctx = nullcontext(proxy_consensus)
+    else:
+        ctx = batched()
+    applied = 0
+    with ctx as app:
+        for block, psh in items:
+            if before_block is not None:
+                before_block(block, psh)
+            validate_block(state, block, check_last_commit=check_last_commit)
+            fail_point("ApplyBlock.validated")
+            resp = exec_block_on_app(app, block, event_cache)
+            fail_point("ApplyBlock.executed")
+            if tx_indexer is not None:
+                tx_indexer.index_block(block, resp)
+            state.save_abci_responses(resp)
+            fail_point("ApplyBlock.savedResponses")
+            block_id = BlockID(hash=block.hash(), parts=psh)
+            state.set_block_and_validators(block.header, block_id,
+                                           resp.end_block_diffs)
+            commit_state_update_mempool(state, app, block, mempool)
+            fail_point("ApplyBlock.committed")
+            applied += 1
+            if save_every and applied % save_every == 0:
+                state.save()
+            if on_applied is not None:
+                on_applied(block)
+            if stop_when is not None and stop_when():
+                break
+    if applied and not (save_every and applied % save_every == 0):
+        state.save()
+    return applied
+
+
 def commit_state_update_mempool(state: State, proxy_consensus, block,
                                 mempool) -> None:
     """App Commit with the mempool locked so no CheckTx runs against a

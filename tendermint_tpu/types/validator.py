@@ -503,6 +503,126 @@ def merge_commit_lanes(arrays: list[tuple]) -> tuple:
             np.concatenate([a[4] for a in arrays]))
 
 
+def _window_fast_eligible(val_set: ValidatorSet, items: list[tuple]) -> bool:
+    """True when every commit in the window satisfies, by inspection, all
+    preconditions the per-block `_compact_commit_lanes` checks — so the
+    vectorized pass below cannot diverge from the loop it replaces.  Any
+    violation (or any object-form commit) routes to the per-block path,
+    which raises the canonical error with the canonical message."""
+    from tendermint_tpu.types.block import CompactCommit
+    v = val_set.size()
+    return v > 0 and all(
+        isinstance(c, CompactCommit)
+        and len(c.present) == v
+        and c.height_ == h
+        and c.sigs.shape == (v, 64)
+        and len(c.block_id.hash) == 32
+        and len(c.block_id.parts.hash) == 32
+        for _bid, h, c in items)
+
+
+def window_commit_lanes(val_set: ValidatorSet, chain_id: str,
+                        items: list[tuple]) -> tuple:
+    """Window-level lane builder: the vectorized fusion of per-block
+    `commit_verify_lanes` + `merge_commit_lanes` over a whole fast-sync
+    window (`items` = [(block_id, height, commit)]).
+
+    The per-block loop is the replay pipeline's scalar tail: 625 rounds
+    of sign-bytes assembly, flatnonzero, sig-slice copies, and a 625-way
+    concatenate, all holding the GIL inside the prep stage.  When every
+    commit is an array-native `CompactCommit` (the form fast-sync
+    stores), the whole window collapses to one `batch_sign_bytes` call,
+    one boolean-matrix nonzero, and one fancy-indexed sig gather —
+    byte-identical to the loop (property-tested), a couple of numpy
+    passes instead of ~6 x B Python-level array ops.  Any object-form
+    commit or precondition violation falls back to the per-block path so
+    results and errors match exactly.
+
+    Returns (templates[T,128], tmpl_idx[N], sigs[N,64], idxs[N],
+    counts[B], tallied[B], foreign[B]): the first four are the merged
+    device batch exactly as `merge_commit_lanes` lays it out; the last
+    three are per-block lane counts, tallied power for the expected
+    block, and foreign (other non-nil block) power — everything the
+    post-verify tally needs, with no per-block arrays retained.
+    Structural errors raise `CommitFormatError` naming the height.
+    """
+    if not items:
+        z = np.zeros(0, dtype=np.int64)
+        return (np.zeros((0, canonical.SIGN_BYTES_LEN), dtype=np.uint8),
+                np.zeros(0, dtype=np.int32),
+                np.zeros((0, 64), dtype=np.uint8),
+                np.zeros(0, dtype=np.int32), z, z.copy(), z.copy())
+    if not _window_fast_eligible(val_set, items):
+        arrays = []
+        for bid, h, c in items:
+            try:
+                arrays.append(
+                    val_set.commit_verify_lanes(chain_id, bid, h, c))
+            except ValueError as e:
+                # stale/malformed commit: surface the height so the
+                # caller can blame the successor's deliverer
+                raise CommitFormatError(h, str(e)) from None
+        templates, tmpl_idx, sigs, idxs = merge_commit_lanes(arrays)
+        counts = np.asarray([len(a[4]) for a in arrays], dtype=np.int64)
+        tallied = np.asarray([int(a[3].sum()) for a in arrays],
+                             dtype=np.int64)
+        foreign = np.asarray([a[5] for a in arrays], dtype=np.int64)
+        return templates, tmpl_idx, sigs, idxs, counts, tallied, foreign
+    b = len(items)
+    heights = np.fromiter((c.height_ for _, _, c in items), np.int64, b)
+    rounds = np.fromiter((c.round_ for _, _, c in items), np.int64, b)
+    totals = np.fromiter((c.block_id.parts.total for _, _, c in items),
+                         np.int64, b)
+    bh = np.frombuffer(b"".join(c.block_id.hash for _, _, c in items),
+                       np.uint8).reshape(b, 32)
+    ph = np.frombuffer(b"".join(c.block_id.parts.hash for _, _, c in items),
+                       np.uint8).reshape(b, 32)
+    templates = canonical.batch_sign_bytes(
+        chain_id, np.full(b, canonical.TYPE_PRECOMMIT, dtype=np.int64),
+        heights, rounds, bh, ph, totals)
+    present = np.stack([c.present for _, _, c in items])    # bool[B,V]
+    # row-major nonzero == per-block flatnonzero, already in merge order
+    lane_b, lane_v = np.nonzero(present)
+    idxs = lane_v.astype(np.int32)
+    tmpl_idx = lane_b.astype(np.int32)   # one template per compact commit
+    all_sigs = np.stack([c.sigs for _, _, c in items])      # uint8[B,V,64]
+    sigs = np.ascontiguousarray(all_sigs[lane_b, lane_v])
+    counts = present.sum(axis=1, dtype=np.int64)
+    powers = np.where(present, val_set._powers_arr()[np.newaxis, :], 0)
+    row_power = powers.sum(axis=1, dtype=np.int64)
+    same = np.fromiter(
+        (c.block_id.key() == bid.key() for bid, _, c in items), bool, b)
+    # validate_basic already rejects nil compact commits, so every
+    # non-matching commit endorses a foreign non-nil block
+    tallied = np.where(same, row_power, 0)
+    foreign = np.where(same, 0, row_power)
+    return templates, tmpl_idx, sigs, idxs, counts, tallied, foreign
+
+
+def window_tally_check(items: list[tuple], ok: np.ndarray,
+                       counts: np.ndarray, tallied: np.ndarray,
+                       foreign: np.ndarray, total: int) -> None:
+    """Post-verify window tally, vectorized: raise the canonical
+    per-height error for the FIRST block (in window order) whose lanes
+    fail or whose tallied power misses +2/3 — identical blame semantics
+    to the per-block loop it replaces."""
+    bounds = np.cumsum(counts)
+    if not ok.all():
+        lane = int(np.argmin(ok))
+        blk = int(np.searchsorted(bounds, lane, side="right"))
+        first = int(bounds[blk - 1]) if blk else 0
+        h = items[blk][1]
+        raise CommitSignatureError(h, int(np.argmin(ok[first:bounds[blk]])))
+    short = np.flatnonzero(~(tallied * 3 > total * 2))
+    if len(short):
+        blk = int(short[0])
+        h = items[blk][1]
+        raise CommitPowerError(
+            h, int(tallied[blk]), total,
+            _foreign_explains_shortfall(int(tallied[blk]),
+                                        int(foreign[blk]), total))
+
+
 def verify_commits_batched(val_set: ValidatorSet, chain_id: str,
                            items: list[tuple]) -> None:
     """Verify MANY commits against one validator set in a single device
@@ -512,37 +632,21 @@ def verify_commits_batched(val_set: ValidatorSet, chain_id: str,
     one-at-a-time `Validators.VerifyCommit`
     (reference `blockchain/reactor.go:230-231`): all (block x validator)
     signature lanes flatten into one batch so the device sees a single
-    large verify instead of K small ones.  Raises ValueError naming the
-    first failing height.
+    large verify instead of K small ones.  Lane assembly and the
+    post-verify tally are window-vectorized (`window_commit_lanes`) so
+    the host never loops per block on the hot path.  Raises ValueError
+    naming the first failing height.
     """
     from tendermint_tpu.crypto import backend as cb
     if not items:
         return
-    arrays = []
-    for bid, h, c in items:
-        try:
-            arrays.append(val_set.commit_verify_lanes(chain_id, bid, h, c))
-        except ValueError as e:
-            # stale/malformed commit: surface the height so the caller
-            # can blame the successor's deliverer (see CommitFormatError)
-            raise CommitFormatError(h, str(e)) from None
-    counts = [len(a[4]) for a in arrays]
-    templates, tmpl_idx, sigs, idxs = merge_commit_lanes(arrays)
+    templates, tmpl_idx, sigs, idxs, counts, tallied, foreign = \
+        window_commit_lanes(val_set, chain_id, items)
     ok = cb.verify_grouped_templated(val_set.set_key(),
                                      val_set.pubs_matrix(), idxs,
                                      tmpl_idx, templates, sigs)
-    off = 0
-    total = val_set.total_voting_power()
-    for (bid, h, _), a, n in zip(items, arrays, counts):
-        lane_ok = ok[off:off + n]
-        off += n
-        if not lane_ok.all():
-            raise CommitSignatureError(h, int(np.argmin(lane_ok)))
-        tallied = int(a[3].sum())
-        if not tallied * 3 > total * 2:
-            raise CommitPowerError(
-                h, tallied, total,
-                _foreign_explains_shortfall(tallied, a[5], total))
+    window_tally_check(items, ok, counts, tallied, foreign,
+                       val_set.total_voting_power())
 
 
 def _foreign_explains_shortfall(tallied: int, foreign_power: int,

@@ -1,0 +1,107 @@
+"""`execution.apply_window` must be `apply_block` unrolled: same final
+app hash and state, same per-block hook order, and (with save_every=1)
+byte-identical persisted state — the license for the reactor and bench
+to amortize app-lock and state-save costs across a fast-sync window."""
+
+import pytest
+
+from tendermint_tpu.proxy import ClientCreator
+from tendermint_tpu.state import execution
+from tendermint_tpu.state.state import get_state
+from tendermint_tpu.utils.db import MemDB
+from tests.chainutil import (build_chain, kvstore_app_hashes,
+                             make_genesis, make_validators)
+
+CHAIN = "apply-window-test"
+N = 6
+
+
+@pytest.fixture()
+def fixture():
+    privs, vs = make_validators(4)
+    gen = make_genesis(CHAIN, privs)
+    chain = build_chain(privs, vs, CHAIN, N,
+                        app_hashes=kvstore_app_hashes(N))
+    return gen, chain
+
+
+def _fresh(gen):
+    db = MemDB()
+    state = get_state(db, gen)
+    conns = ClientCreator("kvstore").new_app_conns()
+    return db, state, conns
+
+
+def _apply_per_block(gen, chain):
+    db, state, conns = _fresh(gen)
+    for block, ps, _seen in chain:
+        execution.apply_block(state, None, conns.consensus, block,
+                              ps.header, execution.MockMempool(),
+                              check_last_commit=False)
+    return db, state
+
+
+@pytest.mark.parametrize("save_every", [1, 0, 4])
+def test_apply_window_matches_per_block(fixture, save_every):
+    gen, chain = fixture
+    ref_db, ref_state = _apply_per_block(gen, chain)
+
+    db, state, conns = _fresh(gen)
+    applied = execution.apply_window(
+        state, None, conns.consensus,
+        [(b, ps.header) for b, ps, _ in chain],
+        execution.MockMempool(), save_every=save_every)
+    assert applied == N
+    assert state.last_block_height == N
+    assert state.app_hash == ref_state.app_hash
+    assert state.last_block_id.key() == ref_state.last_block_id.key()
+    if save_every == 1:
+        # per-block persistence discipline: identical stored bytes
+        assert db._d == ref_db._d
+    else:
+        # deferred saves still land the final state on disk
+        assert db._d[b"stateKey"] == ref_db._d[b"stateKey"]
+
+
+def test_apply_window_hooks_and_early_stop(fixture):
+    gen, chain = fixture
+    db, state, conns = _fresh(gen)
+    before, applied_blocks = [], []
+    n = execution.apply_window(
+        state, None, conns.consensus,
+        [(b, ps.header) for b, ps, _ in chain],
+        execution.MockMempool(), save_every=1,
+        before_block=lambda b, psh: before.append(b.height),
+        on_applied=lambda b: applied_blocks.append(b.height),
+        stop_when=lambda: len(applied_blocks) >= 3)
+    assert n == 3
+    assert before == [1, 2, 3]
+    assert applied_blocks == [1, 2, 3]
+    assert state.last_block_height == 3
+    # stopping early with save_every=1 leaves state saved at height 3
+    from tendermint_tpu.state.state import State
+    assert State.decode_bytes(db._d[b"stateKey"]).last_block_height == 3
+
+
+def test_apply_window_empty():
+    privs, vs = make_validators(4)
+    gen = make_genesis(CHAIN, privs)
+    db, state, conns = _fresh(gen)
+    before = dict(db._d)
+    assert execution.apply_window(
+        state, None, conns.consensus, [], execution.MockMempool(),
+        save_every=0) == 0
+    # no spurious save of the untouched state
+    assert db._d == before
+
+
+def test_apply_window_validation_failure_keeps_prefix(fixture):
+    gen, chain = fixture
+    db, state, conns = _fresh(gen)
+    items = [(b, ps.header) for b, ps, _ in chain]
+    items[3] = (chain[4][0], chain[4][1].header)   # wrong height at slot 3
+    with pytest.raises(ValueError, match="wrong height"):
+        execution.apply_window(state, None, conns.consensus, items,
+                               execution.MockMempool(), save_every=1)
+    # blocks before the bad one are applied and saved
+    assert state.last_block_height == 3

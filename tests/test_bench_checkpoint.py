@@ -64,7 +64,9 @@ def test_budget_manager():
 
 def test_fixture_cache_roundtrip(tmp_path, monkeypatch):
     monkeypatch.setenv("TM_BENCH_CACHE_DIR", str(tmp_path))
-    path = bench._fixture_cache_file(4, 10, 128, 0)
+    # salt no longer keys the cache: retries re-salt from the memoized
+    # base fixture instead of building a second on-disk entry
+    path = bench._fixture_cache_file(4, 10, 128)
     assert str(tmp_path) in path
     assert bench._fixture_cache_load(path) is None
     hashes = [b"", b"\x01" * 20, b"\x02" * 20]
@@ -76,7 +78,7 @@ def test_fixture_cache_roundtrip(tmp_path, monkeypatch):
     assert (got[1] == sigs).all()
     # over the size cap: silently not cached
     monkeypatch.setenv("TM_BENCH_CACHE_MAX_MB", "0.0001")
-    path2 = bench._fixture_cache_file(4, 11, 128, 0)
+    path2 = bench._fixture_cache_file(4, 11, 128)
     bench._fixture_cache_save(path2, hashes, sigs)
     assert bench._fixture_cache_load(path2) is None
 

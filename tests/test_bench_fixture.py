@@ -1,0 +1,112 @@
+"""Re-salt fixture tests: a degraded-run retry must derive a salted
+chain from the cached base fixture by re-signing only the bumped
+heights (`_resalt_pass2`), never rebuilding blocks or app hashes — the
+contract behind the <60s retry budget.  The device signer is stubbed
+with the pure-python reference signer so the test runs anywhere in
+milliseconds-per-lane; the shapes and code path are the real ones."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import bench
+from tendermint_tpu.crypto import backend as cb
+from tendermint_tpu.crypto import pure_ed25519 as ref
+from tendermint_tpu.types import canonical
+
+
+def test_resalt_plan_covers_every_window_at_named_scale():
+    """At the named 100k-block scale every 625-block window must contain
+    bumped heights for ANY salt — each window's verify upload is
+    byte-distinct, so a result cache cannot flatter a retry."""
+    n_blocks, window = 100_000, 625
+    for salt in (1, 2, 99, 100, 12345):
+        stride, bump = bench._resalt_plan(n_blocks, salt)
+        assert stride == 100 and bump == salt % 100
+        hs = np.arange(1, n_blocks + 1)
+        bumped = hs[hs % stride == bump]
+        per_window = np.bincount((bumped - 1) // window,
+                                 minlength=n_blocks // window)
+        assert per_window.min() >= 6, (salt, per_window.min())
+
+
+@pytest.mark.parametrize("n_blocks", [1, 2, 5, 99])
+def test_resalt_plan_tiny_fixtures_always_bump(n_blocks):
+    """Quick fixtures shrink the stride so at least one block bumps."""
+    for salt in (1, 3, 7, 1000):
+        stride, bump = bench._resalt_plan(n_blocks, salt)
+        assert stride == max(1, min(100, n_blocks))
+        hs = np.arange(1, n_blocks + 1)
+        assert (hs % stride == bump).any(), (n_blocks, salt)
+
+
+def _host_sign_templated(be, seeds, n_vals, templates):
+    """Reference-signer stand-in for `_device_sign_templated`: same
+    (nb * n_vals, 64) layout, no jax."""
+    out = np.zeros((len(templates) * n_vals, 64), np.uint8)
+    for t, tmpl in enumerate(templates):
+        msg = tmpl.tobytes()
+        for v in range(n_vals):
+            out[t * n_vals + v] = np.frombuffer(
+                ref.sign(seeds[v], msg), np.uint8)
+    return out
+
+
+def test_resalt_reuses_base_and_resigns_only_bumped_heights(monkeypatch):
+    n_vals, n_blocks, payload = 3, 12, 64
+    calls = []
+
+    def counting_sign(be, seeds, nv, templates):
+        calls.append(len(templates))
+        return _host_sign_templated(be, seeds, nv, templates)
+
+    monkeypatch.setattr(bench, "_device_sign_templated", counting_sign)
+    monkeypatch.setattr(cb, "set_backend", lambda name: None)
+    key = (n_vals, n_blocks, payload)
+    monkeypatch.delitem(bench._FIXTURE_MEMO, key, raising=False)
+
+    try:
+        privs, vs, gen, base = bench._build_bench_chain_fast(
+            n_vals, n_blocks, payload=payload, salt=0, _use_cache=False)
+        assert len(calls) == 1 and calls[0] == n_blocks
+        assert all(cc.round_ == 0 for _, _, cc in base)
+
+        salt = 7
+        _, _, _, salted = bench._build_bench_chain_fast(
+            n_vals, n_blocks, payload=payload, salt=salt,
+            _use_cache=False)
+        # the memoized base was reused: only the bumped heights were
+        # re-signed (stride shrinks to n_blocks, so exactly one here)
+        stride, bump = bench._resalt_plan(n_blocks, salt)
+        bumped = [h for h in range(1, n_blocks + 1)
+                  if h % stride == bump]
+        assert len(calls) == 2 and calls[1] == len(bumped) == 1
+
+        memo = bench._FIXTURE_MEMO[key]
+        for (blk, _, cc), (sblk, _, scc) in zip(base, salted):
+            assert sblk is blk          # pass-1 blocks are shared
+            if cc.height_ in bumped:
+                assert scc.round_ == salt
+                assert not np.array_equal(scc.sigs, cc.sigs)
+                # the re-signed lanes verify against the salted template
+                tmpl = canonical.batch_sign_bytes(
+                    memo["chain_id"],
+                    np.array([canonical.TYPE_PRECOMMIT], np.int64),
+                    np.array([cc.height_], np.int64),
+                    np.array([salt], np.int64),
+                    memo["bh"][cc.height_ - 1:cc.height_],
+                    memo["ph"][cc.height_ - 1:cc.height_],
+                    memo["pt"][cc.height_ - 1:cc.height_])[0].tobytes()
+                for v in range(n_vals):
+                    assert ref.verify(memo["pubs"][v], tmpl,
+                                      scc.sigs[v].tobytes())
+            else:
+                assert scc.round_ == 0
+                assert np.array_equal(scc.sigs, cc.sigs)
+    finally:
+        bench._FIXTURE_MEMO.pop(key, None)

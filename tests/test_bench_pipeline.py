@@ -1,0 +1,87 @@
+"""Pipeline regression gates for the bench replay: per-window overlap
+attribution must ride every result, the window-vectorized prep path must
+actually drive the replay (final state reaches the chain head), and the
+prep pool must not leak threads into subsequent configs — plus the
+tier-1 subprocess smoke for `bench --quick --config 3` (toy scale via
+the TM_BENCH_QUICK_* knobs; the full 100-validator comb-table build is
+CPU-minutes and stays out of tier-1)."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _bench_prep_threads():
+    return [t for t in threading.enumerate()
+            if t.name.startswith("bench-prep")]
+
+
+def test_replay_chain_emits_overlap_and_reaps_prep_threads():
+    import bench
+    from tendermint_tpu.utils import attribution, tracing
+
+    before = len(_bench_prep_threads())
+    res = bench._replay_chain(n_vals=4, n_blocks=48, backend="python",
+                              window=8)
+    # the pipeline drove the chain to the head and timed every stage
+    assert res["blocks"] == 48 and res["blocks_per_sec"] > 0
+    # per-replay overlap attribution is part of the result contract
+    assert res["windows"] == 6
+    assert 0.0 <= res["overlap_fraction"] <= 1.0
+    assert 0.0 <= res["min_window_overlap"] <= res["overlap_fraction"] + 1e-9
+    # clean shutdown: no bench-prep worker survives the replay
+    assert len(_bench_prep_threads()) == before
+
+    # window keys are namespaced per replay (r<seq>.<win>) so attempts
+    # never merge in the doctor's grouping
+    rows = attribution.window_attribution(tracing.RECORDER.snapshot())
+    tags = {str(r["window"]).split(".")[0] for r in rows
+            if isinstance(r["window"], str)}
+    assert len(tags) >= 1
+    res2 = bench._replay_chain(n_vals=4, n_blocks=16, backend="python",
+                               window=8)
+    rows2 = attribution.window_attribution(tracing.RECORDER.snapshot())
+    tags2 = {str(r["window"]).split(".")[0] for r in rows2
+             if isinstance(r["window"], str)}
+    assert len(tags2) > len(tags)   # the second replay got its own tag
+    assert res2["windows"] == 2
+
+
+def test_bench_quick_config3_smoke(tmp_path):
+    """`bench --quick --config 3` on CPU must exit 0 and append a
+    BENCH_LEDGER entry carrying config3 rates, the healthy-bar fields,
+    overlap attribution, and the run-level attribution block."""
+    # the persistent XLA compile cache is shared deliberately: the first
+    # run ever pays the toy-shape compiles (~1 min), every later tier-1
+    # run hits the disk cache — a per-test cache dir would re-pay the
+    # compile on every CI run
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "TM_BENCH_QUICK_BLOCKS": "24", "TM_BENCH_QUICK_VALS": "8"}
+    out = subprocess.run(
+        [sys.executable, "bench.py", "--quick", "--config", "3",
+         "--ledger", str(tmp_path / "ledger.jsonl"),
+         "--partial-out", str(tmp_path / "partial.json"),
+         "--trace-out", str(tmp_path / "trace.json")],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=420)
+    assert out.returncode == 0, out.stderr[-2000:]
+    headline = json.loads(out.stdout.strip().splitlines()[-1])
+    assert headline["metric"] != "bench_failed", out.stderr[-2000:]
+
+    with open(tmp_path / "ledger.jsonl") as f:
+        entries = [json.loads(ln) for ln in f if ln.strip()]
+    assert len(entries) == 1
+    cfg = entries[0]["configs"]["config3"]
+    assert cfg["sigs_per_sec"] > 0
+    assert cfg["blocks"] == 24 and cfg["validators"] == 8
+    # overlap attribution attached to the config result...
+    assert "overlap_fraction" in cfg and cfg["windows"] >= 1
+    # ...and the run-level attribution block rides the ledger entry
+    assert entries[0]["attribution"]["wall"] > 0
+    # the CPU anchor fields the degraded-run logs are keyed to
+    assert cfg["cpu_pipeline_sigs_per_sec"] > 0
+    assert cfg["attempts"] == 1 and not cfg["degraded"]

@@ -323,10 +323,65 @@ def test_metric_name_series_collision_and_bad_label(tmp_path):
     assert any("reserved" in m for m in msgs), findings
 
 
+def test_bench_scalar_loop_flags_loop_in_prep_span(tmp_path):
+    findings = lint_src(tmp_path, """
+        from tendermint_tpu.utils import tracing
+
+        def prep(blocks):
+            with tracing.span("bench.prep", blocks=len(blocks)):
+                lanes = []
+                for b in blocks:
+                    lanes.append(b.lanes())
+            return lanes
+
+        def apply(items):
+            with tracing.span("bench.apply", blocks=len(items)):
+                while items:
+                    items.pop()
+        """)
+    loops = [f for f in findings if f.rule == "bench-scalar-loop"]
+    assert len(loops) == 2, findings
+    assert "bench.prep" in loops[0].message
+    assert "bench.apply" in loops[1].message
+
+
+def test_bench_scalar_loop_quiet_on_vectorized_and_other_spans(tmp_path):
+    findings = lint_src(tmp_path, """
+        from tendermint_tpu.utils import tracing
+
+        def prep(blocks, window_commit_lanes, pool):
+            with tracing.span("bench.prep", blocks=len(blocks)):
+                parts = list(pool.map(hash, blocks))          # executor
+                items = [(b, p) for b, p in zip(blocks, parts)]
+                lanes = window_commit_lanes(items)            # one pass
+
+        def dispatch(items):
+            # dispatch/verify spans are not host-stage categories
+            with tracing.span("bench.dispatch", blocks=len(items)):
+                for it in items:
+                    it.upload()
+
+        def fastsync_apply(items, apply_window):
+            # the reactor's span: same category, different prefix — the
+            # rule is scoped to the bench's spans
+            with tracing.span("fastsync.apply", blocks=len(items)):
+                for it in items:
+                    it.go()
+
+        def helper_defined_inside(items):
+            with tracing.span("bench.apply", blocks=len(items)):
+                def later():
+                    for it in items:    # runs elsewhere, not in-span
+                        it.go()
+                return later
+        """)
+    assert [f for f in findings if f.rule == "bench-scalar-loop"] == []
+
+
 def test_rule_catalog_covers_all_families():
     from tendermint_tpu.analysis import all_rules
     names = {n for n, _ in all_rules()}
     assert {"lock-order", "unlocked-write", "jax-host-sync",
             "jax-retrace", "jax-static-argnums", "route-gating",
             "route-write-containment", "span-category",
-            "metric-name"} <= names
+            "bench-scalar-loop", "metric-name"} <= names

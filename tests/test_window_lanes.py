@@ -1,0 +1,194 @@
+"""Property tests for the window-vectorized lane builder: for every
+window of commits, `window_commit_lanes` must be BYTE-identical to the
+per-block `commit_verify_lanes` + `merge_commit_lanes` path it fuses —
+arrays, per-block tallies, and error blame all match.  This is the
+license for the bench/reactor prep stage to take the one-numpy-pass fast
+path: any divergence here is a consensus-verification bug, not a perf
+regression."""
+
+import numpy as np
+import pytest
+
+from tendermint_tpu.types import BlockID, Commit, ZERO_BLOCK_ID
+from tendermint_tpu.types.block import CompactCommit, PartSetHeader
+from tendermint_tpu.types.canonical import TYPE_PRECOMMIT
+from tendermint_tpu.types.validator import (CommitFormatError,
+                                            CommitPowerError,
+                                            CommitSignatureError,
+                                            ValidatorSet, Validator,
+                                            merge_commit_lanes,
+                                            window_commit_lanes,
+                                            window_tally_check)
+from tests.chainutil import (build_chain, make_validators, sign_vote)
+
+CHAIN = "window-lanes-test"
+
+
+def rand_bid(rng):
+    return BlockID(rng.integers(0, 256, 32, dtype=np.uint8).tobytes(),
+                   PartSetHeader(int(rng.integers(1, 5)),
+                                 rng.integers(0, 256, 32,
+                                              dtype=np.uint8).tobytes()))
+
+
+def rand_compact_window(rng, vs, n_blocks, foreign_p=0.3):
+    """Random CompactCommit window: random presence masks, rounds, and a
+    fraction of commits endorsing a foreign block."""
+    v = vs.size()
+    items = []
+    for h in range(1, n_blocks + 1):
+        bid = rand_bid(rng)
+        cbid = bid if rng.random() >= foreign_p else rand_bid(rng)
+        cc = CompactCommit(
+            block_id=cbid, height_=h, round_=int(rng.integers(0, 3)),
+            sigs=rng.integers(0, 256, (v, 64), dtype=np.uint8),
+            present=rng.random(v) < 0.8)
+        items.append((bid, h, cc))
+    return items
+
+
+def per_block_reference(vs, items):
+    """The scalar path the fast path must reproduce."""
+    arrays = [vs.commit_verify_lanes(CHAIN, bid, h, c)
+              for bid, h, c in items]
+    merged = merge_commit_lanes(arrays)
+    counts = np.asarray([len(a[4]) for a in arrays], dtype=np.int64)
+    tallied = np.asarray([int(a[3].sum()) for a in arrays],
+                         dtype=np.int64)
+    foreign = np.asarray([a[5] for a in arrays], dtype=np.int64)
+    return merged + (counts, tallied, foreign)
+
+
+def assert_windows_equal(fast, ref):
+    names = ("templates", "tmpl_idx", "sigs", "idxs", "counts",
+             "tallied", "foreign")
+    for name, f, r in zip(names, fast, ref):
+        assert f.dtype == r.dtype, name
+        assert f.shape == r.shape, name
+        assert np.array_equal(f, r), name
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_compact_window_byte_identical(seed):
+    rng = np.random.default_rng(seed)
+    n_vals = int(rng.integers(1, 12))
+    _, vs = make_validators(n_vals, seed=seed)
+    items = rand_compact_window(rng, vs, int(rng.integers(1, 20)))
+    assert_windows_equal(window_commit_lanes(vs, CHAIN, items),
+                         per_block_reference(vs, items))
+
+
+def test_compact_window_uneven_powers():
+    """Tallied/foreign power must weight by validator power, not count."""
+    rng = np.random.default_rng(99)
+    privs, _ = make_validators(6, seed=1)
+    vs = ValidatorSet([Validator(p.pub_key, 10 + 7 * i)
+                       for i, p in enumerate(privs)])
+    items = rand_compact_window(rng, vs, 10, foreign_p=0.5)
+    assert_windows_equal(window_commit_lanes(vs, CHAIN, items),
+                         per_block_reference(vs, items))
+
+
+def test_real_chain_compact_vs_object_form():
+    """A real signed chain: the compact fast path and the object-form
+    fallback must produce the same device batch."""
+    privs, vs = make_validators(4)
+    chain = build_chain(privs, vs, CHAIN, 6)
+    obj_items, cc_items = [], []
+    for block, ps, seen in chain:
+        bid = BlockID(block.hash(), ps.header)
+        obj_items.append((bid, block.height, seen))
+        cc = CompactCommit.from_commit(seen)
+        assert cc is not None
+        cc_items.append((bid, block.height, cc))
+    fast = window_commit_lanes(vs, CHAIN, cc_items)
+    ref = window_commit_lanes(vs, CHAIN, obj_items)   # fallback path
+    assert_windows_equal(fast, ref)
+    # unanimous same-block commits: full power tallied, nothing foreign
+    assert (fast[5] == vs.total_voting_power()).all()
+    assert (fast[6] == 0).all()
+
+
+def test_mixed_window_falls_back_and_matches():
+    """One object-form commit (with an absent AND a nil vote) routes the
+    whole window through the per-block path; the result still equals the
+    per-block reference."""
+    privs, vs = make_validators(4)
+    chain = build_chain(privs, vs, CHAIN, 5)
+    items = []
+    for i, (block, ps, seen) in enumerate(chain):
+        bid = BlockID(block.hash(), ps.header)
+        if i == 2:
+            # rebuild the commit with validator 0 absent and validator 1
+            # voting nil — the strays CompactCommit cannot represent
+            votes = list(seen.precommits)
+            votes[0] = None
+            # fresh PrivValidator objects (same keys): the originals'
+            # HRS double-sign guard rejects re-signing an old height
+            fresh, _ = make_validators(4)
+            by_idx = {vs.index_of(p.address): p for p in fresh}
+            votes[1] = sign_vote(by_idx[1], vs, CHAIN, block.height, 0,
+                                 TYPE_PRECOMMIT, ZERO_BLOCK_ID)
+            seen = Commit(block_id=seen.block_id, precommits=votes)
+            assert CompactCommit.from_commit(seen) is None
+        else:
+            seen = CompactCommit.from_commit(seen)
+        items.append((bid, block.height, seen))
+    fast = window_commit_lanes(vs, CHAIN, items)
+    assert_windows_equal(fast, per_block_reference(vs, items))
+    # the doctored block: 3 lanes (the nil vote still verifies), only 2
+    # tallied, none foreign (nil votes never count as foreign)
+    assert fast[4][2] == 3 and fast[5][2] == 20 and fast[6][2] == 0
+
+
+def test_empty_window():
+    _, vs = make_validators(3)
+    out = window_commit_lanes(vs, CHAIN, [])
+    assert all(len(a) == 0 for a in out)
+
+
+def test_malformed_commit_raises_format_error_with_height():
+    rng = np.random.default_rng(5)
+    _, vs = make_validators(4, seed=2)
+    items = rand_compact_window(rng, vs, 4, foreign_p=0.0)
+    bid, h, cc = items[2]
+    items[2] = (bid, h, CompactCommit(block_id=cc.block_id, height_=h + 9,
+                                      round_=0, sigs=cc.sigs,
+                                      present=cc.present))
+    with pytest.raises(CommitFormatError) as ei:
+        window_commit_lanes(vs, CHAIN, items)
+    assert ei.value.height == h
+
+
+def test_tally_check_blames_first_failing_block():
+    rng = np.random.default_rng(6)
+    _, vs = make_validators(5, seed=4)
+    items = []
+    for h in range(1, 5):
+        bid = rand_bid(rng)
+        items.append((bid, h, CompactCommit(
+            block_id=bid, height_=h, round_=0,
+            sigs=rng.integers(0, 256, (5, 64), dtype=np.uint8),
+            present=np.ones(5, dtype=bool))))
+    _, _, _, _, counts, tallied, foreign = \
+        window_commit_lanes(vs, CHAIN, items)
+    total = vs.total_voting_power()
+
+    # all lanes verify, all power present: no error
+    ok = np.ones(int(counts.sum()), dtype=bool)
+    window_tally_check(items, ok, counts, tallied, foreign, total)
+
+    # a failed lane in block 3 (window order) blames height 3 with the
+    # block-local lane index
+    bad = ok.copy()
+    bad[int(counts[:2].sum()) + 1] = False
+    with pytest.raises(CommitSignatureError) as ei:
+        window_tally_check(items, bad, counts, tallied, foreign, total)
+    assert ei.value.height == 3 and ei.value.lane == 1
+
+    # power shortfall in block 2 blames height 2
+    short = tallied.copy()
+    short[1] = total * 2 // 3
+    with pytest.raises(CommitPowerError) as ei:
+        window_tally_check(items, ok, counts, short, foreign, total)
+    assert ei.value.height == 2
