@@ -1,0 +1,29 @@
+# Developer entry points. Everything runs on CPU by default
+# (JAX_PLATFORMS=cpu) so the targets work on a laptop; unset it to run
+# against real devices.
+
+PY      ?= python
+JAXENV  ?= JAX_PLATFORMS=cpu
+SEEDS   ?= 0:5
+
+.PHONY: test test-slow lint chaos-smoke chaos-nightly
+
+test:            ## tier-1: the fast suite
+	$(JAXENV) $(PY) -m pytest tests/ -q -m 'not slow'
+
+test-slow:       ## the stress tier (slow+faults scenarios)
+	$(JAXENV) $(PY) -m pytest tests/ -q -m slow
+
+lint:            ## tmlint static invariants over the package
+	$(JAXENV) $(PY) -m tendermint_tpu.cli lint
+
+chaos-smoke:     ## fast fault-scenario subset under a CI budget
+	$(JAXENV) $(PY) -m tendermint_tpu.cli chaos smoke --budget 300
+
+# The nightly soak gate: full catalogue (smoke + every stress rig,
+# including the 50/100-validator live rounds) swept over $(SEEDS),
+# per-seed metric-budget verdicts appended to CHAOS_LEDGER.jsonl, a
+# durable triage bundle per failure or breach, nonzero exit on either.
+chaos-nightly:   ## full-catalogue seed-swept soak gate
+	$(JAXENV) $(PY) -m tendermint_tpu.cli chaos nightly \
+	    --seed-range $(SEEDS) --artifacts chaos_artifacts

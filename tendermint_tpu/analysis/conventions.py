@@ -25,6 +25,14 @@ names.
   must not shadow reserved ones, and the generated ``_bucket``/``_sum``
   /``_count`` series must not collide across instruments (a collision
   corrupts the whole scrape).
+
+- **scenario-budget**: every stress-tier scenario registration (a
+  ``register(...)`` call carrying ``safety=``/``liveness=`` where
+  ``smoke`` is absent or not literally ``True``) must declare at least
+  one metric budget via ``budgets={...}``.  A stress rig without a
+  budgeted metric only fails on outright invariant violations — a
+  fault-path latency regression sails through green, which is exactly
+  what the chaos ledger exists to catch.
 """
 
 from __future__ import annotations
@@ -273,3 +281,47 @@ class MetricNameRule(Rule):
                         self.name, call,
                         f"label '{label}' is reserved in the Prometheus "
                         f"exposition format")
+
+
+# ---------------------------------------------------------------------------
+# scenario metric budgets
+# ---------------------------------------------------------------------------
+
+
+@register
+class ScenarioBudgetRule(Rule):
+    name = "scenario-budget"
+    description = ("stress-tier scenario registrations must declare at "
+                   "least one metric budget (budgets={...})")
+
+    def visit_file(self, ctx: FileCtx):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if call_name(node).rsplit(".", 1)[-1] != "register":
+                continue
+            kwargs = {kw.arg: kw.value for kw in node.keywords if kw.arg}
+            # scenario registrations carry both invariant lists — that
+            # shape separates them from the analysis-rule @register
+            # decorator and any other register() in the tree
+            if "safety" not in kwargs or "liveness" not in kwargs:
+                continue
+            smoke = kwargs.get("smoke")
+            if isinstance(smoke, ast.Constant) and smoke.value is True:
+                continue                    # smoke tier: budgets optional
+            budgets = kwargs.get("budgets")
+            empty = (budgets is None
+                     or (isinstance(budgets, ast.Constant)
+                         and budgets.value is None)
+                     or (isinstance(budgets, ast.Dict)
+                         and not budgets.keys))
+            if empty:
+                sc_name = (_str_const(node.args[0])
+                           if node.args else None) or "<dynamic>"
+                yield ctx.finding(
+                    self.name, node,
+                    f"stress scenario '{sc_name}' declares no metric "
+                    f"budgets: without a budgeted metric a fault-path "
+                    f"latency regression reads as green — declare "
+                    f"budgets={{\"<metric>\": {{\"max\": ...}}}} and "
+                    f"report it in the body's budget_metrics")

@@ -577,12 +577,14 @@ def cmd_chaos_run(args) -> int:
     the half-open seed range instead."""
     from tendermint_tpu.scenarios import (parse_seed_range, run_scenario,
                                           run_sweep)
+    backend = getattr(args, "backend", "") or None
     if getattr(args, "seed_range", ""):
         seeds = parse_seed_range(args.seed_range)
         out = run_sweep(
             [args.scenario], seeds,
             artifacts=args.artifacts or None,
             keep_artifacts=args.keep_artifacts, ledger_path=None,
+            backend=backend,
             progress=(None if args.json
                       else lambda r: _print_scenario_result(r, False)))
         summary = out["summary"]
@@ -599,7 +601,8 @@ def cmd_chaos_run(args) -> int:
         return 1 if bad else 0
     result = run_scenario(args.scenario, seed=args.seed,
                           artifacts=args.artifacts or None,
-                          keep_artifacts=args.keep_artifacts)
+                          keep_artifacts=args.keep_artifacts,
+                          backend=backend)
     _print_scenario_result(result, args.json)
     return 0 if result.ok and not result.budget_breaches else 1
 
@@ -615,9 +618,12 @@ def cmd_chaos_replay(args) -> int:
         manifest = json.load(f)
     name, seed = manifest["scenario"], manifest["seed"]
     want = manifest["event_log_hash"]
+    # the backend rung is part of the hashed plan: a replay must run on
+    # the SAME rung the original did or the hashes diverge by design
     result = run_scenario(name, seed=seed,
                           artifacts=args.artifacts or None,
-                          keep_artifacts=args.keep_artifacts)
+                          keep_artifacts=args.keep_artifacts,
+                          backend=manifest.get("backend") or None)
     _print_scenario_result(result, args.json)
     if result.event_log_hash == want:
         print(f"MATCH: replay reproduced event log {want[:16]}")
@@ -646,7 +652,8 @@ def cmd_chaos_smoke(args) -> int:
             continue
         result = run_scenario(name, seed=args.seed,
                               artifacts=args.artifacts or None,
-                              keep_artifacts=args.keep_artifacts)
+                              keep_artifacts=args.keep_artifacts,
+                              backend=getattr(args, "backend", "") or None)
         results.append(result)
         _print_scenario_result(result, args.json)
         if not result.ok:
@@ -699,7 +706,8 @@ def cmd_chaos_soak(args) -> int:
             continue
         out = run_sweep([name], seeds, artifacts=args.artifacts or None,
                         keep_artifacts=args.keep_artifacts,
-                        ledger_path=None, progress=progress)
+                        ledger_path=None, progress=progress,
+                        backend=getattr(args, "backend", "") or None)
         configs.update(out["summary"]["configs"])
         all_results.extend(out["results"])
     failures = [r for r in all_results if not r.ok]
@@ -737,6 +745,98 @@ def cmd_chaos_soak(args) -> int:
     if regressions:
         print(f"rate regressions vs best prior: {', '.join(regressions)}")
     print(f"chaos soak [{args.tier}] seeds {args.seed_range}: "
+          f"{len(all_results) - len(failures)}/{len(all_results)} passed, "
+          f"{len(breaches)} over budget, {len(skipped)} scenarios "
+          f"skipped in {_time.time() - t0:.1f}s"
+          + (f" (ledger: {args.budget_ledger})"
+             if args.budget_ledger else ""))
+    return 1 if failures or breaches else 0
+
+
+def cmd_chaos_nightly(args) -> int:
+    """The nightly soak gate: sweep the FULL catalogue (smoke tier in
+    cheapest-first order, then every stress rig) across a seed range,
+    with per-seed metric-budget verdicts ledgered to the chaos ledger
+    and a durable triage bundle for every failed or over-budget run.
+    This is `chaos soak --tier all` hardened into a gate: per-run
+    ledger entries (schema tpu-bft-chaos-run/1) land for every seed so
+    a budget regression bisects to the exact scenario+seed, scenarios
+    that miss the global wall cap are reported as SKIPPED (a skip is
+    visible in the summary and the ledger, never silent), and the exit
+    code is nonzero on any invariant failure or metric/wall budget
+    breach."""
+    import time as _time
+    from tendermint_tpu.scenarios import (SCENARIOS, SMOKE_ORDER,
+                                          parse_seed_range, run_sweep)
+    from tendermint_tpu.scenarios.engine import CHAOS_LEDGER_SCHEMA
+    from tendermint_tpu.utils import ledger as ledgermod
+    seeds = parse_seed_range(args.seed_range)
+    names = [n for n in SMOKE_ORDER if n in SCENARIOS]
+    names += sorted(n for n, sc in SCENARIOS.items()
+                    if sc.smoke and n not in names)
+    names += sorted(n for n, sc in SCENARIOS.items() if not sc.smoke)
+    if args.scenarios:
+        want = [s.strip() for s in args.scenarios.split(",") if s.strip()]
+        unknown = [w for w in want if w not in SCENARIOS]
+        if unknown:
+            print(f"unknown scenarios: {', '.join(unknown)} "
+                  f"(see `chaos list`)", file=sys.stderr)
+            return 2
+        names = want                       # explicit list overrides
+    backend = getattr(args, "backend", "") or None
+    t0 = _time.time()
+    skipped: list[str] = []
+    all_results: list = []
+    configs: dict = {}
+    progress = (None if args.json
+                else lambda r: _print_scenario_result(r, False))
+    for name in names:
+        if args.budget and _time.time() - t0 >= args.budget:
+            skipped.append(name)
+            continue
+        # ledger_path here (unlike soak) so every seed's run lands as
+        # its own tpu-bft-chaos-run/1 entry carrying the per-metric
+        # budget verdicts — the nightly's bisectable record
+        out = run_sweep([name], seeds, artifacts=args.artifacts or None,
+                        keep_artifacts=args.keep_artifacts,
+                        ledger_path=args.budget_ledger or None,
+                        progress=progress, backend=backend)
+        configs.update(out["summary"]["configs"])
+        all_results.extend(out["results"])
+    failures = [r for r in all_results if not r.ok]
+    breaches = [r for r in all_results if r.budget_breaches]
+    triage = sorted({r.artifact_dir for r in failures + breaches
+                     if r.artifact_dir})
+    deltas: dict = {}
+    if args.budget_ledger:
+        prior = [e for e in ledgermod.load(args.budget_ledger)
+                 if e.get("schema") == CHAOS_LEDGER_SCHEMA]
+        deltas = ledgermod.compute_deltas(prior, configs)
+        ledgermod.append_entry(args.budget_ledger, {
+            "schema": CHAOS_LEDGER_SCHEMA, "nightly": True,
+            "seed_range": args.seed_range, "n_seeds": len(seeds),
+            "configs": configs, "skipped": skipped,
+            "backend": backend or "",
+            "timestamp": _time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                        _time.gmtime())})
+    if args.json:
+        print(json.dumps({
+            "seed_range": args.seed_range, "configs": configs,
+            "skipped": skipped, "deltas": deltas,
+            "runs": len(all_results), "failures": len(failures),
+            "breaches": len(breaches), "triage": triage,
+            "duration_s": round(_time.time() - t0, 1)}, indent=1))
+        return 1 if failures or breaches else 0
+    for name in skipped:
+        print(f"SKIP {name} x{len(seeds)} seeds "
+              f"(global budget {args.budget:.0f}s spent)")
+    for d in triage:
+        print(f"triage: {d}")
+    regressions = sorted(n for n, row in deltas.items()
+                         if row.get("regression"))
+    if regressions:
+        print(f"rate regressions vs best prior: {', '.join(regressions)}")
+    print(f"chaos nightly seeds {args.seed_range}: "
           f"{len(all_results) - len(failures)}/{len(all_results)} passed, "
           f"{len(breaches)} over budget, {len(skipped)} scenarios "
           f"skipped in {_time.time() - t0:.1f}s"
@@ -914,13 +1014,19 @@ def main(argv=None) -> int:
     chaos_sub = sp.add_subparsers(dest="chaos_command", required=True)
 
     def _chaos_common(csp, scenario_arg: bool):
-        from tendermint_tpu.scenarios.engine import DEFAULT_SEED
+        from tendermint_tpu.scenarios.engine import (DEFAULT_SEED,
+                                                     KNOWN_BACKENDS)
         if scenario_arg:
             csp.add_argument("--scenario", required=True,
                              help="scenario name (see `chaos list`)")
         csp.add_argument("--seed", type=int, default=DEFAULT_SEED,
                          help="scenario seed; the same seed replays the "
                               "same fault schedule (default: %(default)s)")
+        csp.add_argument("--backend", choices=list(KNOWN_BACKENDS),
+                         default="",
+                         help="crypto backend rung for the run "
+                              "(overrides TM_SCENARIO_BACKEND and the "
+                              "scenario's declared default)")
         csp.add_argument("--artifacts", default="",
                          help="artifact root (default: "
                               "$TM_SCENARIO_ARTIFACTS or "
@@ -963,7 +1069,9 @@ def main(argv=None) -> int:
                           "(default: %(default)s)")
     csp.set_defaults(fn=cmd_chaos_smoke)
 
-    from tendermint_tpu.scenarios.engine import DEFAULT_CHAOS_LEDGER
+    from tendermint_tpu.scenarios.engine import (DEFAULT_CHAOS_LEDGER,
+                                                 KNOWN_BACKENDS
+                                                 as _KNOWN_BACKENDS)
     csp = chaos_sub.add_parser(
         "soak", help="nightly seed-sweep soak across a catalogue tier "
                      "with budget enforcement and a chaos ledger")
@@ -985,11 +1093,44 @@ def main(argv=None) -> int:
                      help="chaos ledger path for per-scenario rates and "
                           "regression deltas; empty to disable "
                           "(default: %(default)s)")
+    csp.add_argument("--backend", choices=list(_KNOWN_BACKENDS),
+                     default="",
+                     help="crypto backend rung for every run (overrides "
+                          "TM_SCENARIO_BACKEND and scenario defaults)")
     csp.add_argument("--artifacts", default="")
     csp.add_argument("--keep-artifacts", dest="keep_artifacts",
                      action="store_true")
     csp.add_argument("--json", action="store_true")
     csp.set_defaults(fn=cmd_chaos_soak)
+
+    csp = chaos_sub.add_parser(
+        "nightly", help="the nightly soak gate: full-catalogue seed "
+                        "sweep with per-seed metric-budget verdicts "
+                        "ledgered and durable triage bundles on breach")
+    csp.add_argument("--seed-range", dest="seed_range", default="0:5",
+                     help="half-open seed range A:B to sweep "
+                          "(default: %(default)s)")
+    csp.add_argument("--scenarios", default="",
+                     help="comma-separated scenario names; overrides "
+                          "the full catalogue when given")
+    csp.add_argument("--budget", type=float, default=0.0,
+                     help="global wall-clock cap in seconds; scenarios "
+                          "that don't fit are reported as SKIPPED, never "
+                          "silently dropped (0 = uncapped)")
+    csp.add_argument("--budget-ledger", dest="budget_ledger",
+                     default=DEFAULT_CHAOS_LEDGER,
+                     help="chaos ledger path; every seed's run lands as "
+                          "its own entry with metric-budget verdicts, "
+                          "plus one aggregate row (default: %(default)s)")
+    csp.add_argument("--backend", choices=list(_KNOWN_BACKENDS),
+                     default="",
+                     help="crypto backend rung for every run (overrides "
+                          "TM_SCENARIO_BACKEND and scenario defaults)")
+    csp.add_argument("--artifacts", default="")
+    csp.add_argument("--keep-artifacts", dest="keep_artifacts",
+                     action="store_true")
+    csp.add_argument("--json", action="store_true")
+    csp.set_defaults(fn=cmd_chaos_nightly)
 
     sp = sub.add_parser("version", help="print version")
     sp.set_defaults(fn=cmd_version)

@@ -300,7 +300,15 @@ class ConsensusState:
     def _microbatch_threshold(self) -> int:
         from tendermint_tpu.crypto import backend as cb
         be = cb.get_backend()
-        if getattr(be, "name", "") != "tpu":
+        name = getattr(be, "name", "")
+        if name == "supervised":
+            # a supervised ladder batches exactly when its ACTIVE rung is
+            # the device — after a breaker demotion the ladder serves
+            # from a CPU rung, where batching would be a slowdown (see
+            # below), so the threshold must track demotions/recoveries
+            active = getattr(be, "active_rung_name", lambda: None)()
+            name = active or ""
+        if name != "tpu":
             # ONLY the device backend batches: the scalar arrival path
             # verifies through the NATIVE one-shot primitive (~0.15 ms),
             # so routing a run through e.g. the python backend's grouped

@@ -129,14 +129,22 @@ class PythonBackend:
     name = "python"
 
     def verify_batch(self, pubkeys, msgs, sigs):
+        # verification is a pure function of (pub, msg, sig), so lanes
+        # share the process-wide memo with the scalar vote path
+        # (types/keys.py).  The repeat shape this serves: every node of
+        # an in-process rig validates the SAME LastCommit (N sigs x N
+        # nodes per height) — first check settles each lane for everyone
+        # else.  Chaos/spot-check machinery is unaffected: injection
+        # corrupts results at the supervised-rung wrapper, above here.
+        from tendermint_tpu.types.keys import _verify_memo
         out = np.zeros(len(pubkeys), dtype=bool)
         # "scalar." prefix -> CAT_SCALAR: this is the scalar-tail time
         # the attribution doctor reports when work falls off the device
         with tracing.span("scalar.verify", lanes=len(pubkeys)):
             for i in range(len(pubkeys)):
-                out[i] = _ref.verify(pubkeys[i].tobytes(),
-                                     msgs[i].tobytes(),
-                                     sigs[i].tobytes())
+                out[i] = _verify_memo(pubkeys[i].tobytes(),
+                                      msgs[i].tobytes(),
+                                      sigs[i].tobytes())
         REGISTRY.sigs_requested.inc(len(pubkeys))
         REGISTRY.sigs_verified.inc(int(out.sum()))
         return out

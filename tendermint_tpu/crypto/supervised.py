@@ -423,6 +423,14 @@ class SupervisedBackend:
                 return rung
         return None
 
+    def active_rung_name(self) -> str | None:
+        """Name of the rung the ladder would serve from right now — the
+        hook consumers (vote-ingest micro-batching, scenario manifests)
+        use to make device-vs-scalar decisions through the supervisor
+        without reaching into breaker internals."""
+        rung = self._active_rung()
+        return rung.name if rung is not None else None
+
     # -- passthroughs ---------------------------------------------------
     def tables_cached(self, set_key: bytes) -> bool:
         """True when the ACTIVE rung would serve this set without a

@@ -9,16 +9,21 @@ for the seed-replay contract and `catalog.py` for the shipped
 scenarios; drive from the command line with `cli chaos`.
 """
 
-from tendermint_tpu.scenarios.engine import (DEFAULT_CHAOS_LEDGER,
-                                             DEFAULT_SEED, SCENARIOS,
+from tendermint_tpu.scenarios.engine import (CHAOS_RUN_SCHEMA,
+                                             DEFAULT_CHAOS_LEDGER,
+                                             DEFAULT_SEED, KNOWN_BACKENDS,
+                                             SCENARIOS,
                                              InvariantViolation,
                                              ScenarioResult, artifacts_root,
                                              parse_seed_range, register,
+                                             resolve_backend,
                                              run_scenario, run_sweep)
 from tendermint_tpu.scenarios import catalog  # registers the shipped set
+from tendermint_tpu.scenarios import live    # registers the big-rig tier
 from tendermint_tpu.scenarios.catalog import SMOKE_ORDER
 
-__all__ = ["DEFAULT_CHAOS_LEDGER", "DEFAULT_SEED", "SCENARIOS",
-           "SMOKE_ORDER", "InvariantViolation", "ScenarioResult",
-           "artifacts_root", "catalog", "parse_seed_range", "register",
-           "run_scenario", "run_sweep"]
+__all__ = ["CHAOS_RUN_SCHEMA", "DEFAULT_CHAOS_LEDGER", "DEFAULT_SEED",
+           "KNOWN_BACKENDS", "SCENARIOS", "SMOKE_ORDER",
+           "InvariantViolation", "ScenarioResult", "artifacts_root",
+           "catalog", "live", "parse_seed_range", "register",
+           "resolve_backend", "run_scenario", "run_sweep"]

@@ -15,7 +15,6 @@ below +2/3, for blocks it wants a syncing node to accept.
 
 from __future__ import annotations
 
-import contextlib
 import os
 import tempfile
 import threading
@@ -43,16 +42,6 @@ from tendermint_tpu.utils.db import MemDB
 from tendermint_tpu.utils.metrics import REGISTRY
 
 
-@contextlib.contextmanager
-def _python_backend():
-    old = cb._current
-    cb.set_backend("python")
-    try:
-        yield
-    finally:
-        cb._current = old
-
-
 # ===========================================================================
 # byz-equivocation (smoke)
 # ===========================================================================
@@ -60,31 +49,30 @@ def _python_backend():
 def _byz_equivocation(ctx):
     chain_id = "chaos-equivocation"
     target = 4
-    with _python_backend():
-        nodes, _privs, _gen = harness.wire_net(chain_id, 4, seed=1)
-        byz = nodes[0]
-        heights = injectors.plan_heights(ctx, "equivocation",
-                                         1, target + 2, k=3)
-        evidence: list = []
-        ev_lock = threading.Lock()
-        for nd in nodes[1:]:
-            nd.cs.evsw.subscribe(
-                "scenario", "EvidenceDoubleSign",
-                lambda e: (ev_lock.acquire(), evidence.append(e),
-                           ev_lock.release()))
-        injectors.equivocate(ctx, byz, byz.priv, chain_id, heights)
+    nodes, _privs, _gen = harness.wire_net(chain_id, 4, seed=1)
+    byz = nodes[0]
+    heights = injectors.plan_heights(ctx, "equivocation",
+                                     1, target + 2, k=3)
+    evidence: list = []
+    ev_lock = threading.Lock()
+    for nd in nodes[1:]:
+        nd.cs.evsw.subscribe(
+            "scenario", "EvidenceDoubleSign",
+            lambda e: (ev_lock.acquire(), evidence.append(e),
+                       ev_lock.release()))
+    injectors.equivocate(ctx, byz, byz.priv, chain_id, heights)
+    for nd in nodes:
+        nd.cs.start()
+    try:
+        nodes[1].mempool.check_tx(b"chaos=equivocation")
+        reached = harness.wait_until(
+            lambda: all(nd.block_store.height >= target
+                        for nd in nodes[1:]), timeout=60)
+        captured = harness.wait_until(lambda: bool(evidence),
+                                      timeout=20)
+    finally:
         for nd in nodes:
-            nd.cs.start()
-        try:
-            nodes[1].mempool.check_tx(b"chaos=equivocation")
-            reached = harness.wait_until(
-                lambda: all(nd.block_store.height >= target
-                            for nd in nodes[1:]), timeout=60)
-            captured = harness.wait_until(lambda: bool(evidence),
-                                          timeout=20)
-        finally:
-            for nd in nodes:
-                nd.cs.stop()
+            nd.cs.stop()
     with ev_lock:
         ev_count = len(evidence)
         ev_ok = all(
@@ -134,31 +122,30 @@ register(
 
 def _evidence_flood(ctx):
     chain_id = "chaos-evflood"
-    with _python_backend():
-        privs, vs = fixtures.make_validators(4, seed=2)
-        pool = EvidencePool(MemDB(), chain_id)
-        real, bogus = injectors.fabricate_evidence(
-            ctx, privs, vs, chain_id, n_real=6, n_bogus=18)
-        # a solo validator keeps committing while the flood lands
-        nodes, _, _ = harness.wire_net(chain_id, 1, seed=3)
-        solo = nodes[0]
-        solo.cs.start()
-        try:
-            h_before = solo.block_store.height
-            salvo = ([("real", e) for e in real]
-                     + [("bogus", e) for e in bogus])
-            ctx.rng("flood-order").shuffle(salvo)
-            accepted = {"real": 0, "bogus": 0}
-            for kind, e in salvo:
-                if pool.add(e, vs):
-                    accepted[kind] += 1
-            flood_done_h = solo.block_store.height
-            progressed = harness.wait_until(
-                lambda: solo.block_store.height >= flood_done_h + 2,
-                timeout=30)
-            h_after = solo.block_store.height
-        finally:
-            solo.cs.stop()
+    privs, vs = fixtures.make_validators(4, seed=2)
+    pool = EvidencePool(MemDB(), chain_id)
+    real, bogus = injectors.fabricate_evidence(
+        ctx, privs, vs, chain_id, n_real=6, n_bogus=18)
+    # a solo validator keeps committing while the flood lands
+    nodes, _, _ = harness.wire_net(chain_id, 1, seed=3)
+    solo = nodes[0]
+    solo.cs.start()
+    try:
+        h_before = solo.block_store.height
+        salvo = ([("real", e) for e in real]
+                 + [("bogus", e) for e in bogus])
+        ctx.rng("flood-order").shuffle(salvo)
+        accepted = {"real": 0, "bogus": 0}
+        for kind, e in salvo:
+            if pool.add(e, vs):
+                accepted[kind] += 1
+        flood_done_h = solo.block_store.height
+        progressed = harness.wait_until(
+            lambda: solo.block_store.height >= flood_done_h + 2,
+            timeout=30)
+        h_after = solo.block_store.height
+    finally:
+        solo.cs.stop()
     ctx.note("flood.result", accepted=accepted, pool_size=pool.size())
     return {"accepted_real": accepted["real"],
             "accepted_bogus": accepted["bogus"],
@@ -209,68 +196,67 @@ def _device_rung_walk(ctx):
     # the programmatic TM_CHAOS_CRYPTO path: install the validated config
     # and let the supervisor pick it up via CryptoChaos.current()
     chaosmod.install(chaosmod.ChaosConfig(seed=ctx.seed, crypto=spec))
-    with _python_backend():
-        privs, vs = fixtures.make_validators(4, seed=4)
-        gen = fixtures.make_genesis(chain_id, privs)
-        hashes = fixtures.kvstore_app_hashes(N_RUNGWALK_BLOCKS)
-        chain = fixtures.build_chain(privs, vs, chain_id,
-                                     N_RUNGWALK_BLOCKS, app_hashes=hashes)
-        src_sw, _, src_store = harness.fastsync_source(chain_id, chain, gen)
-        sync_sw, bc, _cons, sync_store = harness.fastsync_syncer(
-            chain_id, gen, batch_size=2)
-        sup = SupervisedBackend(
-            [("dev", PythonBackend()), ("python", PythonBackend())],
-            breaker_threshold=1, breaker_cooldown_s=0.2,
-            retries=0, call_timeout_s=30.0)
-        evicted: list = []
-        orig_evict = bc.pool.on_evict
-        bc.pool.on_evict = lambda p, r: (evicted.append(p),
-                                         orig_evict and orig_evict(p, r))
-        trips0 = REGISTRY.crypto_breaker_trips.value
-        recov0 = REGISTRY.crypto_breaker_recoveries.value
-        old = cb._current
-        cb._current = sup
-        src_sw.start(); sync_sw.start()
-        try:
-            connect_switches(sync_sw, src_sw)
-            deadline = time.time() + 90
-            snapped = False
-            while (sync_store.height < N_RUNGWALK_BLOCKS - 1
-                   and time.time() < deadline):
-                if (REGISTRY.crypto_breaker_trips.value > trips0
-                        and sup.chaos is not None and sup.chaos.active):
-                    # fault storm "clears" after the first trip; from
-                    # here the half-open probe must restore the rung
-                    ctx.snapshot_metrics("faulted")
-                    snapped = True
-                    sup.chaos.active = False
-                    ctx.note("chaos.cleared", mode=sup.chaos.mode)
-                time.sleep(0.02)
-            if not snapped:
+    privs, vs = fixtures.make_validators(4, seed=4)
+    gen = fixtures.make_genesis(chain_id, privs)
+    hashes = fixtures.kvstore_app_hashes(N_RUNGWALK_BLOCKS)
+    chain = fixtures.build_chain(privs, vs, chain_id,
+                                 N_RUNGWALK_BLOCKS, app_hashes=hashes)
+    src_sw, _, src_store = harness.fastsync_source(chain_id, chain, gen)
+    sync_sw, bc, _cons, sync_store = harness.fastsync_syncer(
+        chain_id, gen, batch_size=2)
+    sup = SupervisedBackend(
+        [("dev", PythonBackend()), ("python", PythonBackend())],
+        breaker_threshold=1, breaker_cooldown_s=0.2,
+        retries=0, call_timeout_s=30.0)
+    evicted: list = []
+    orig_evict = bc.pool.on_evict
+    bc.pool.on_evict = lambda p, r: (evicted.append(p),
+                                     orig_evict and orig_evict(p, r))
+    trips0 = REGISTRY.crypto_breaker_trips.value
+    recov0 = REGISTRY.crypto_breaker_recoveries.value
+    old = cb._current
+    cb._current = sup
+    src_sw.start(); sync_sw.start()
+    try:
+        connect_switches(sync_sw, src_sw)
+        deadline = time.time() + 90
+        snapped = False
+        while (sync_store.height < N_RUNGWALK_BLOCKS - 1
+               and time.time() < deadline):
+            if (REGISTRY.crypto_breaker_trips.value > trips0
+                    and sup.chaos is not None and sup.chaos.active):
+                # fault storm "clears" after the first trip; from
+                # here the half-open probe must restore the rung
                 ctx.snapshot_metrics("faulted")
-            synced = sync_store.height >= N_RUNGWALK_BLOCKS - 1
-            # drive half-open probes until the breaker recovers
-            from tendermint_tpu.crypto import pure_ed25519 as ref
-            seed32 = bytes(32)
-            pub = np.frombuffer(ref.pubkey_from_seed(seed32), np.uint8)
-            msg = np.zeros(32, np.uint8)
-            sig = np.frombuffer(ref.sign(seed32, msg.tobytes()), np.uint8)
-            deadline = time.time() + 10
-            while (REGISTRY.crypto_breaker_recoveries.value == recov0
-                   and time.time() < deadline):
-                sup.verify_batch(pub[None, :], msg[None, :], sig[None, :])
-                time.sleep(0.05)
-            recovered = (REGISTRY.crypto_breaker_recoveries.value > recov0
-                         and sup._rungs[0].state == CLOSED)
-            chain_ok = all(
-                sync_store.load_block(h).hash()
-                == src_store.load_block(h).hash()
-                for h in range(1, min(sync_store.height,
-                                      N_RUNGWALK_BLOCKS - 2) + 1))
-            app_hash_ok = bc.state.app_hash == hashes[-1]
-        finally:
-            src_sw.stop(); sync_sw.stop()
-            cb._current = old
+                snapped = True
+                sup.chaos.active = False
+                ctx.note("chaos.cleared", mode=sup.chaos.mode)
+            time.sleep(0.02)
+        if not snapped:
+            ctx.snapshot_metrics("faulted")
+        synced = sync_store.height >= N_RUNGWALK_BLOCKS - 1
+        # drive half-open probes until the breaker recovers
+        from tendermint_tpu.crypto import pure_ed25519 as ref
+        seed32 = bytes(32)
+        pub = np.frombuffer(ref.pubkey_from_seed(seed32), np.uint8)
+        msg = np.zeros(32, np.uint8)
+        sig = np.frombuffer(ref.sign(seed32, msg.tobytes()), np.uint8)
+        deadline = time.time() + 10
+        while (REGISTRY.crypto_breaker_recoveries.value == recov0
+               and time.time() < deadline):
+            sup.verify_batch(pub[None, :], msg[None, :], sig[None, :])
+            time.sleep(0.05)
+        recovered = (REGISTRY.crypto_breaker_recoveries.value > recov0
+                     and sup._rungs[0].state == CLOSED)
+        chain_ok = all(
+            sync_store.load_block(h).hash()
+            == src_store.load_block(h).hash()
+            for h in range(1, min(sync_store.height,
+                                  N_RUNGWALK_BLOCKS - 2) + 1))
+        app_hash_ok = bc.state.app_hash == hashes[-1]
+    finally:
+        src_sw.stop(); sync_sw.stop()
+        cb._current = old
     status = sup.supervisor_status()
     ctx.note("rungwalk.result", synced_height=sync_store.height,
              recovered=recovered, active_rung=status.get("active_rung"),
@@ -400,48 +386,52 @@ N_REPLAY_BLOCKS = 24
 
 def _commit_replay_body(ctx, mode: str):
     chain_id = f"chaos-{mode}-replay"
-    with _python_backend():
-        privs, vs = fixtures.make_validators(4, seed=5)
-        gen = fixtures.make_genesis(chain_id, privs)
-        hashes = fixtures.kvstore_app_hashes(N_REPLAY_BLOCKS)
-        chain = fixtures.build_chain(privs, vs, chain_id, N_REPLAY_BLOCKS,
-                                     app_hashes=hashes)
-        heights = injectors.plan_heights(ctx, f"{mode}-heights",
-                                         3, N_REPLAY_BLOCKS - 2, k=3)
-        byz_sw, _, _ = harness.fastsync_source(chain_id, chain, gen,
-                                               moniker="byz")
-        injectors.tamper_block_server(ctx, byz_sw, chain, mode, heights)
-        honest_sw, _, honest_store = harness.fastsync_source(
-            chain_id, chain, gen, moniker="honest")
-        sync_sw, bc, _cons, sync_store = harness.fastsync_syncer(
-            chain_id, gen, batch_size=4)
-        evicted: list = []
-        orig_evict = bc.pool.on_evict
-        bc.pool.on_evict = lambda p, r: (evicted.append(p),
-                                         orig_evict and orig_evict(p, r))
+    privs, vs = fixtures.make_validators(4, seed=5)
+    gen = fixtures.make_genesis(chain_id, privs)
+    hashes = fixtures.kvstore_app_hashes(N_REPLAY_BLOCKS)
+    chain = fixtures.build_chain(privs, vs, chain_id, N_REPLAY_BLOCKS,
+                                 app_hashes=hashes)
+    heights = injectors.plan_heights(ctx, f"{mode}-heights",
+                                     3, N_REPLAY_BLOCKS - 2, k=3)
+    byz_sw, _, _ = harness.fastsync_source(chain_id, chain, gen,
+                                           moniker="byz")
+    injectors.tamper_block_server(ctx, byz_sw, chain, mode, heights)
+    honest_sw, _, honest_store = harness.fastsync_source(
+        chain_id, chain, gen, moniker="honest")
+    sync_sw, bc, _cons, sync_store = harness.fastsync_syncer(
+        chain_id, gen, batch_size=4)
+    evicted: list = []
+    orig_evict = bc.pool.on_evict
+    bc.pool.on_evict = lambda p, r: (evicted.append(p),
+                                     orig_evict and orig_evict(p, r))
+    for sw in (byz_sw, honest_sw, sync_sw):
+        sw.start()
+    try:
+        t_sync0 = time.time()
+        connect_switches(sync_sw, byz_sw)
+        connect_switches(sync_sw, honest_sw)
+        honest_id = honest_sw.node_info.id
+        synced = harness.wait_until(
+            lambda: sync_store.height >= N_REPLAY_BLOCKS - 1,
+            timeout=60)
+        sync_s = max(time.time() - t_sync0, 1e-6)
+        chain_ok = all(
+            sync_store.load_block(h).hash()
+            == honest_store.load_block(h).hash()
+            for h in range(1, min(sync_store.height,
+                                  N_REPLAY_BLOCKS - 2) + 1))
+    finally:
         for sw in (byz_sw, honest_sw, sync_sw):
-            sw.start()
-        try:
-            connect_switches(sync_sw, byz_sw)
-            connect_switches(sync_sw, honest_sw)
-            honest_id = honest_sw.node_info.id
-            synced = harness.wait_until(
-                lambda: sync_store.height >= N_REPLAY_BLOCKS - 1,
-                timeout=60)
-            chain_ok = all(
-                sync_store.load_block(h).hash()
-                == honest_store.load_block(h).hash()
-                for h in range(1, min(sync_store.height,
-                                      N_REPLAY_BLOCKS - 2) + 1))
-        finally:
-            for sw in (byz_sw, honest_sw, sync_sw):
-                sw.stop()
+            sw.stop()
     ctx.note("replay.result", mode=mode, synced_height=sync_store.height,
              evicted=[p[:12] for p in evicted])
     return {"synced": synced, "chain_ok": chain_ok,
             "honest_evicted": honest_id in evicted,
             "synced_height": sync_store.height,
-            "pool_status": bc.pool.status()}
+            "pool_status": bc.pool.status(),
+            "budget_metrics": {
+                "sync_blocks_per_sec": round(sync_store.height / sync_s,
+                                             3)}}
 
 
 def _replay_safety(ctx, obs):
@@ -476,7 +466,8 @@ for _mode, _desc in (
         safety=[("replayed-commit-rejected", _replay_safety),
                 ("honest-peer-spared", _replay_safety_blame)],
         liveness=[("sync-completes", _replay_liveness)],
-        smoke=False, budget_s=180.0)(
+        smoke=False, budget_s=180.0,
+        budgets={"sync_blocks_per_sec": {"min": 0.2}})(
             (lambda m: lambda ctx: _commit_replay_body(ctx, m))(_mode))
 
 
@@ -487,44 +478,46 @@ for _mode, _desc in (
 def _partition_heal(ctx):
     chain_id = "chaos-partition"
     window_s = 2.0
-    with _python_backend():
-        nodes, _privs = harness.reactor_net(chain_id, 4, fuzz=True, seed=6)
-        victim_i = ctx.rng("partition").randrange(4)
-        ctx.plan("partition", victim=victim_i, window_s=window_s,
-                 direction="inbound")
-        victim = nodes[victim_i]
-        others = [nd for i, nd in enumerate(nodes) if i != victim_i]
-        try:
-            nodes[0].mempool.check_tx(b"chaos=partition")
-            pre_ok = harness.wait_until(
-                lambda: all(nd.block_store.height >= 2 for nd in nodes),
-                timeout=60)
-            h_victim0 = victim.block_store.height
-            # one-directional: the victim goes deaf (its reads stall) but
-            # keeps speaking — the asymmetric-fuzz partition shape
-            injectors.sever_inbound(ctx, victim.fuzz_links(), stall=1.0,
-                                    label=f"node{victim_i}")
-            time.sleep(window_s)
-            h_others_mid = max(nd.block_store.height for nd in others)
-            injectors.restore(ctx, victim.fuzz_links(),
-                              label=f"node{victim_i}")
-            healed = harness.wait_until(
-                lambda: victim.block_store.height >= h_others_mid + 1,
-                timeout=90)
-            quorum_ok = harness.wait_until(
-                lambda: max(nd.block_store.height
-                            for nd in others) > h_others_mid,
-                timeout=60)
-            h_victim1 = victim.block_store.height
-        finally:
-            for nd in nodes:
-                nd.stop()
+    nodes, _privs = harness.reactor_net(chain_id, 4, fuzz=True, seed=6)
+    victim_i = ctx.rng("partition").randrange(4)
+    ctx.plan("partition", victim=victim_i, window_s=window_s,
+             direction="inbound")
+    victim = nodes[victim_i]
+    others = [nd for i, nd in enumerate(nodes) if i != victim_i]
+    try:
+        nodes[0].mempool.check_tx(b"chaos=partition")
+        pre_ok = harness.wait_until(
+            lambda: all(nd.block_store.height >= 2 for nd in nodes),
+            timeout=60)
+        h_victim0 = victim.block_store.height
+        # one-directional: the victim goes deaf (its reads stall) but
+        # keeps speaking — the asymmetric-fuzz partition shape
+        injectors.sever_inbound(ctx, victim.fuzz_links(), stall=1.0,
+                                label=f"node{victim_i}")
+        time.sleep(window_s)
+        h_others_mid = max(nd.block_store.height for nd in others)
+        injectors.restore(ctx, victim.fuzz_links(),
+                          label=f"node{victim_i}")
+        t_heal0 = time.time()
+        healed = harness.wait_until(
+            lambda: victim.block_store.height >= h_others_mid + 1,
+            timeout=90)
+        heal_lag_s = time.time() - t_heal0
+        quorum_ok = harness.wait_until(
+            lambda: max(nd.block_store.height
+                        for nd in others) > h_others_mid,
+            timeout=60)
+        h_victim1 = victim.block_store.height
+    finally:
+        for nd in nodes:
+            nd.stop()
     ctx.note("partition.result", pre_ok=pre_ok, healed=healed,
              heights=[nd.block_store.height for nd in nodes])
     return {"pre_ok": pre_ok, "healed": healed, "quorum_ok": quorum_ok,
             "h_victim_before_heal": h_victim0,
             "h_victim_after_heal": h_victim1,
-            "_stores": [nd.block_store for nd in nodes]}
+            "_stores": [nd.block_store for nd in nodes],
+            "budget_metrics": {"victim_heal_lag_s": round(heal_lag_s, 3)}}
 
 
 def _partition_safety(ctx, obs):
@@ -548,7 +541,8 @@ register(
     "conflicting commits",
     safety=[("no-conflicting-commits", _partition_safety)],
     liveness=[("heal-and-catch-up", _partition_liveness)],
-    smoke=False, budget_s=240.0)(_partition_heal)
+    smoke=False, budget_s=240.0,
+    budgets={"victim_heal_lag_s": {"max": 60.0}})(_partition_heal)
 
 
 # ===========================================================================
@@ -593,9 +587,11 @@ def _crash_restart_storm(ctx):
     # final restart: must replay past the torn tail and keep going
     node = harness.solo_node(home, chain_id)
     node.start()
+    t_restart0 = time.time()
     try:
         progressed = harness.wait_until(
             lambda: node.block_store.height >= target + 2, timeout=60)
+        post_restart_s = time.time() - t_restart0
         final_height = node.block_store.height
         for h in range(1, target + 1):
             if prefix_hashes[h] != node.block_store.load_block(h).hash():
@@ -608,7 +604,9 @@ def _crash_restart_storm(ctx):
              tail_garbage=bool(report["tail_garbage"]))
     return {"progressed": progressed, "prefix_stable": stable,
             "final_height": final_height, "last_target": target,
-            "wal_records": report["records"]}
+            "wal_records": report["records"],
+            "budget_metrics": {
+                "post_restart_progress_s": round(post_restart_s, 3)}}
 
 
 def _crash_safety(ctx, obs):
@@ -631,7 +629,8 @@ register(
     "torn tail, never rewrite a committed block, and keep committing",
     safety=[("committed-prefix-stable", _crash_safety)],
     liveness=[("progress-after-restarts", _crash_liveness)],
-    smoke=False, budget_s=300.0)(_crash_restart_storm)
+    smoke=False, budget_s=300.0,
+    budgets={"post_restart_progress_s": {"max": 45.0}})(_crash_restart_storm)
 
 
 # ===========================================================================
@@ -688,80 +687,83 @@ def _device_storm_partition(ctx):
     spec = "raise:every=6"
     ctx.plan("crypto-chaos", spec=spec)
     chaosmod.install(chaosmod.ChaosConfig(seed=ctx.seed, crypto=spec))
-    with _python_backend():
-        privs, vs = fixtures.make_validators(N_STORM_VALIDATORS, seed=8)
-        gen = fixtures.make_genesis(chain_id, privs)
-        hashes = fixtures.kvstore_app_hashes(N_STORM_BLOCKS)
-        chain = fixtures.build_chain(privs, vs, chain_id, N_STORM_BLOCKS,
-                                     app_hashes=hashes)
-        src_sw, _, src_store = harness.fastsync_source(
-            chain_id, chain, gen, moniker="source",
-            config=_tcp_source_p2p())
-        sync_sw, bc, _cons, sync_store = harness.fastsync_syncer(
-            chain_id, gen, batch_size=4, fuzz=True)
-        sup = SupervisedBackend(
-            [("dev", PythonBackend()), ("python", PythonBackend())],
-            breaker_threshold=1, breaker_cooldown_s=0.2,
-            retries=0, call_timeout_s=30.0)
-        trips0 = REGISTRY.crypto_breaker_trips.value
-        old = cb._current
-        cb._current = sup
-        src_sw.start(); sync_sw.start()
-        src_id = src_sw.node_info.id
-        # the window must outlast the pool's 3s request timeout, and the
-        # stall must outlast the window, or reads merely slow down and
-        # no eviction (hence no reconnect) ever fires
-        window_s = 4.5
-        ctx.plan("partition-window", window_s=window_s)
-        try:
-            sync_sw.dial_peer_async(
-                NetAddress.parse(str(src_sw._listener.addr)),
-                persistent=True)
-            connected = harness.wait_until(
-                lambda: sync_sw.get_peer(src_id) is not None, timeout=15)
+    privs, vs = fixtures.make_validators(N_STORM_VALIDATORS, seed=8)
+    gen = fixtures.make_genesis(chain_id, privs)
+    hashes = fixtures.kvstore_app_hashes(N_STORM_BLOCKS)
+    chain = fixtures.build_chain(privs, vs, chain_id, N_STORM_BLOCKS,
+                                 app_hashes=hashes)
+    src_sw, _, src_store = harness.fastsync_source(
+        chain_id, chain, gen, moniker="source",
+        config=_tcp_source_p2p())
+    sync_sw, bc, _cons, sync_store = harness.fastsync_syncer(
+        chain_id, gen, batch_size=4, fuzz=True)
+    sup = SupervisedBackend(
+        [("dev", PythonBackend()), ("python", PythonBackend())],
+        breaker_threshold=1, breaker_cooldown_s=0.2,
+        retries=0, call_timeout_s=30.0)
+    trips0 = REGISTRY.crypto_breaker_trips.value
+    old = cb._current
+    cb._current = sup
+    src_sw.start(); sync_sw.start()
+    src_id = src_sw.node_info.id
+    # the window must outlast the pool's 3s request timeout, and the
+    # stall must outlast the window, or reads merely slow down and
+    # no eviction (hence no reconnect) ever fires
+    window_s = 4.5
+    ctx.plan("partition-window", window_s=window_s)
+    try:
+        sync_sw.dial_peer_async(
+            NetAddress.parse(str(src_sw._listener.addr)),
+            persistent=True)
+        connected = harness.wait_until(
+            lambda: sync_sw.get_peer(src_id) is not None, timeout=15)
 
-            def partition():
-                # sever only after blocks flowed, so the stall is a real
-                # mid-sync partition (and the pool's starvation eviction
-                # can fire against a peer that HAS delivered)
-                harness.wait_until(lambda: sync_store.height >= 4,
-                                   timeout=30)
-                _sever_window(ctx, sync_sw, src_id, window_s, 6.0,
-                              "syncer<-source")
+        def partition():
+            # sever only after blocks flowed, so the stall is a real
+            # mid-sync partition (and the pool's starvation eviction
+            # can fire against a peer that HAS delivered)
+            harness.wait_until(lambda: sync_store.height >= 4,
+                               timeout=30)
+            _sever_window(ctx, sync_sw, src_id, window_s, 6.0,
+                          "syncer<-source")
 
-            def storm_clear():
-                # the device-fault storm clears only after it provably
-                # hit (first breaker trip), like a real transient fault
-                harness.wait_until(
-                    lambda: REGISTRY.crypto_breaker_trips.value > trips0,
-                    timeout=45)
-                if sup.chaos is not None:
-                    sup.chaos.active = False
-                ctx.note("chaos.cleared")
+        def storm_clear():
+            # the device-fault storm clears only after it provably
+            # hit (first breaker trip), like a real transient fault
+            harness.wait_until(
+                lambda: REGISTRY.crypto_breaker_trips.value > trips0,
+                timeout=45)
+            if sup.chaos is not None:
+                sup.chaos.active = False
+            ctx.note("chaos.cleared")
 
-            sched = ctx.schedule("storm")
-            sched.add("partition", partition, after=0.2, jitter_s=0.5)
-            sched.add("device-storm-clear", storm_clear, after=0.5,
-                      jitter_s=1.0)
-            sched.run(join_timeout_s=90.0)
-            synced = harness.wait_until(
-                lambda: sync_store.height >= N_STORM_BLOCKS - 1,
-                timeout=120)
-            chain_ok = all(
-                sync_store.load_block(h).hash()
-                == src_store.load_block(h).hash()
-                for h in range(1, min(sync_store.height,
-                                      N_STORM_BLOCKS - 2) + 1))
-            src_banned = sync_sw.is_banned(src_id)
-            src_score = sync_sw.misbehavior_score(src_id)
-        finally:
-            src_sw.stop(); sync_sw.stop()
-            cb._current = old
+        sched = ctx.schedule("storm")
+        sched.add("partition", partition, after=0.2, jitter_s=0.5)
+        sched.add("device-storm-clear", storm_clear, after=0.5,
+                  jitter_s=1.0)
+        sched.run(join_timeout_s=90.0)
+        t_sync0 = time.time()
+        synced = harness.wait_until(
+            lambda: sync_store.height >= N_STORM_BLOCKS - 1,
+            timeout=120)
+        sync_s = max(time.time() - t_sync0, 1e-6)
+        chain_ok = all(
+            sync_store.load_block(h).hash()
+            == src_store.load_block(h).hash()
+            for h in range(1, min(sync_store.height,
+                                  N_STORM_BLOCKS - 2) + 1))
+        src_banned = sync_sw.is_banned(src_id)
+        src_score = sync_sw.misbehavior_score(src_id)
+    finally:
+        src_sw.stop(); sync_sw.stop()
+        cb._current = old
     ctx.note("storm-partition.result", synced_height=sync_store.height,
              src_banned=src_banned, src_score=src_score)
     return {"connected": connected, "synced": synced, "chain_ok": chain_ok,
             "src_banned": src_banned, "src_score": src_score,
-            "synced_height": sync_store.height}
+            "synced_height": sync_store.height,
+            "budget_metrics": {
+                "sync_blocks_per_sec": round(sync_store.height / sync_s, 3)}}
 
 
 def _storm_safety(ctx, obs):
@@ -801,7 +803,8 @@ register(
             ("no-peer-blame", _storm_safety_no_blame)],
     liveness=[("sync-completes", _storm_liveness),
               ("storm-and-heal-evidenced", _storm_liveness_evidence)],
-    smoke=False, budget_s=240.0)(_device_storm_partition)
+    smoke=False, budget_s=240.0,
+    budgets={"sync_blocks_per_sec": {"min": 0.1}})(_device_storm_partition)
 
 
 # ---------------------------------------------------------------------------
@@ -820,88 +823,89 @@ ECR_TIMEOUTS = {"timeout_propose": 3.0, "timeout_propose_delta": 1.0,
 
 def _equivocation_crash_restart(ctx):
     chain_id = "chaos-equiv-crash"
-    with _python_backend():
-        # autostart=False: the equivocation hook and evidence watchers
-        # must install before height 1, or a fast net blows past the
-        # scheduled double-sign heights unobserved
-        nodes, privs = harness.reactor_net(chain_id, N_ECR_VALIDATORS,
-                                           seed=7, timeouts=ECR_TIMEOUTS,
-                                           autostart=False)
-        gen = nodes[0].gen
-        byz = nodes[0]
-        victim_i = 1 + ctx.rng("victim").randrange(N_ECR_VALIDATORS - 1)
-        ctx.plan("crash-victim", index=victim_i)
-        heights = injectors.plan_heights(ctx, "equivocation", 2, 6, k=2)
-        evidence: list = []
-        ev_lock = threading.Lock()
-        watchers = [i for i in range(1, N_ECR_VALIDATORS)
-                    if i != victim_i][:2]
-        for i in watchers:
-            nodes[i].cs.evsw.subscribe(
-                "scenario", "EvidenceDoubleSign",
-                lambda e: (ev_lock.acquire(), evidence.append(e),
-                           ev_lock.release()))
-        # in reactor nets votes travel only via the per-peer gossip
-        # routines, which pull from the node's own vote sets — a
-        # conflicting vote is rejected from the set and never gossiped.
-        # The injector must push it onto the wire itself.
-        injectors.equivocate(
-            ctx, byz, privs[0], chain_id, heights,
-            broadcast=lambda msg: byz.switch.broadcast(
-                VOTE_CHANNEL, CM.encode_msg(msg)))
-        harness.start_reactor_net(nodes, stagger_s=0.02)
-        holder = {"victim": nodes[victim_i]}
-        crashed = threading.Event()
-        quorum = [nd for i, nd in enumerate(nodes)
-                  if i not in (0, victim_i)]
-        try:
-            nodes[1].mempool.check_tx(b"chaos=equiv-crash")
-            pre_ok = harness.wait_until(
-                lambda: all(nd.block_store.height >= 2 for nd in nodes),
-                timeout=180)
-            h_mid = max(nd.block_store.height for nd in quorum)
+    # autostart=False: the equivocation hook and evidence watchers
+    # must install before height 1, or a fast net blows past the
+    # scheduled double-sign heights unobserved
+    nodes, privs = harness.reactor_net(chain_id, N_ECR_VALIDATORS,
+                                       seed=7, timeouts=ECR_TIMEOUTS,
+                                       autostart=False)
+    gen = nodes[0].gen
+    byz = nodes[0]
+    victim_i = 1 + ctx.rng("victim").randrange(N_ECR_VALIDATORS - 1)
+    ctx.plan("crash-victim", index=victim_i)
+    heights = injectors.plan_heights(ctx, "equivocation", 2, 6, k=2)
+    evidence: list = []
+    ev_lock = threading.Lock()
+    watchers = [i for i in range(1, N_ECR_VALIDATORS)
+                if i != victim_i][:2]
+    for i in watchers:
+        nodes[i].cs.evsw.subscribe(
+            "scenario", "EvidenceDoubleSign",
+            lambda e: (ev_lock.acquire(), evidence.append(e),
+                       ev_lock.release()))
+    # in reactor nets votes travel only via the per-peer gossip
+    # routines, which pull from the node's own vote sets — a
+    # conflicting vote is rejected from the set and never gossiped.
+    # The injector must push it onto the wire itself.
+    injectors.equivocate(
+        ctx, byz, privs[0], chain_id, heights,
+        broadcast=lambda msg: byz.switch.broadcast(
+            VOTE_CHANNEL, CM.encode_msg(msg)))
+    harness.start_reactor_net(nodes, stagger_s=0.02)
+    holder = {"victim": nodes[victim_i]}
+    crashed = threading.Event()
+    quorum = [nd for i, nd in enumerate(nodes)
+              if i not in (0, victim_i)]
+    try:
+        nodes[1].mempool.check_tx(b"chaos=equiv-crash")
+        pre_ok = harness.wait_until(
+            lambda: all(nd.block_store.height >= 2 for nd in nodes),
+            timeout=180)
+        h_mid = max(nd.block_store.height for nd in quorum)
 
-            def crash():
-                ctx.note("crash.stop", index=victim_i,
-                         height=holder["victim"].block_store.height)
-                holder["victim"].stop()
-                crashed.set()
+        def crash():
+            ctx.note("crash.stop", index=victim_i,
+                     height=holder["victim"].block_store.height)
+            holder["victim"].stop()
+            crashed.set()
 
-            def restart():
-                # the offsets order restart after crash; the event makes
-                # the ordering hard even under scheduler skew
-                crashed.wait(timeout=60)
-                node2 = harness.ReactorNode(
-                    privs[victim_i], gen, chain_id, f"node{victim_i}-r",
-                    cfg=harness.config_with_timeouts(ECR_TIMEOUTS))
-                node2.start()
-                for i, nd in enumerate(nodes):
-                    if i != victim_i:
-                        connect_switches(node2.switch, nd.switch)
-                holder["victim"] = node2
-                ctx.note("crash.restarted", index=victim_i)
-
-            sched = ctx.schedule("crash-restart")
-            sched.add("crash", crash, after=0.1, jitter_s=0.5)
-            sched.add("restart", restart, after=1.5, jitter_s=1.0)
-            sched.run(join_timeout_s=120.0)
-            progressed = harness.wait_until(
-                lambda: max(nd.block_store.height
-                            for nd in quorum) >= h_mid + 2, timeout=180)
-            h_quorum = max(nd.block_store.height for nd in quorum)
-            # the restarted validator rebuilt from GENESIS: catching up
-            # to the quorum proves consensus catchup gossip serves the
-            # whole committed prefix to a from-scratch joiner
-            caught_up = harness.wait_until(
-                lambda: holder["victim"].block_store.height >= h_quorum,
-                timeout=180)
-            captured = harness.wait_until(lambda: bool(evidence),
-                                          timeout=30)
-        finally:
+        def restart():
+            # the offsets order restart after crash; the event makes
+            # the ordering hard even under scheduler skew
+            crashed.wait(timeout=60)
+            node2 = harness.ReactorNode(
+                privs[victim_i], gen, chain_id, f"node{victim_i}-r",
+                cfg=harness.config_with_timeouts(ECR_TIMEOUTS))
+            node2.start()
             for i, nd in enumerate(nodes):
                 if i != victim_i:
-                    nd.stop()
-            holder["victim"].stop()
+                    connect_switches(node2.switch, nd.switch)
+            holder["victim"] = node2
+            ctx.note("crash.restarted", index=victim_i)
+
+        sched = ctx.schedule("crash-restart")
+        sched.add("crash", crash, after=0.1, jitter_s=0.5)
+        sched.add("restart", restart, after=1.5, jitter_s=1.0)
+        sched.run(join_timeout_s=120.0)
+        progressed = harness.wait_until(
+            lambda: max(nd.block_store.height
+                        for nd in quorum) >= h_mid + 2, timeout=180)
+        h_quorum = max(nd.block_store.height for nd in quorum)
+        # the restarted validator rebuilt from GENESIS: catching up
+        # to the quorum proves consensus catchup gossip serves the
+        # whole committed prefix to a from-scratch joiner
+        t_catchup0 = time.time()
+        caught_up = harness.wait_until(
+            lambda: holder["victim"].block_store.height >= h_quorum,
+            timeout=180)
+        catchup_s = time.time() - t_catchup0
+        captured = harness.wait_until(lambda: bool(evidence),
+                                      timeout=30)
+    finally:
+        for i, nd in enumerate(nodes):
+            if i != victim_i:
+                nd.stop()
+        holder["victim"].stop()
     with ev_lock:
         ev_count = len(evidence)
         ev_ok = all(
@@ -916,6 +920,7 @@ def _equivocation_crash_restart(ctx):
             "evidence_count": ev_count, "evidence_wellformed": ev_ok,
             "victim_height": holder["victim"].block_store.height,
             "quorum_height": h_quorum,
+            "budget_metrics": {"victim_catchup_s": round(catchup_s, 3)},
             "_stores": ([nd.block_store for nd in quorum]
                         + [holder["victim"].block_store])}
 
@@ -958,7 +963,8 @@ register(
             ("equivocation-evidenced", _ecr_safety_evidence)],
     liveness=[("quorum-progress", _ecr_liveness),
               ("restart-catch-up", _ecr_liveness_catchup)],
-    smoke=False, budget_s=420.0)(_equivocation_crash_restart)
+    smoke=False, budget_s=420.0,
+    budgets={"victim_catchup_s": {"max": 150.0}})(_equivocation_crash_restart)
 
 
 # ---------------------------------------------------------------------------
@@ -971,77 +977,78 @@ N_SRP_VALIDATORS = 12
 
 def _stale_replay_partition(ctx):
     chain_id = "chaos-stale-partition"
-    with _python_backend():
-        privs, vs = fixtures.make_validators(N_SRP_VALIDATORS, seed=9)
-        gen = fixtures.make_genesis(chain_id, privs)
-        hashes = fixtures.kvstore_app_hashes(N_SRP_BLOCKS)
-        chain = fixtures.build_chain(privs, vs, chain_id, N_SRP_BLOCKS,
-                                     app_hashes=hashes)
-        # a contiguous stale band guarantees the byzantine server gets
-        # asked for at least one tampered height no matter how the pool
-        # splits the request window between the two sources
-        h0 = 8 + ctx.rng("stale-band").randrange(N_SRP_BLOCKS - 14)
-        band = list(range(h0, h0 + 4))
-        byz_sw, _, _ = harness.fastsync_source(chain_id, chain, gen,
-                                               moniker="byz")
-        injectors.tamper_block_server(ctx, byz_sw, chain, "stale", band)
-        honest_sw, _, honest_store = harness.fastsync_source(
-            chain_id, chain, gen, moniker="honest",
-            config=_tcp_source_p2p())
-        sync_sw, bc, _cons, sync_store = harness.fastsync_syncer(
-            chain_id, gen, batch_size=4, fuzz=True)
-        evicted: list = []
-        orig_evict = bc.pool.on_evict
-        bc.pool.on_evict = lambda p, r: (evicted.append((p, r)),
-                                         orig_evict and orig_evict(p, r))
+    privs, vs = fixtures.make_validators(N_SRP_VALIDATORS, seed=9)
+    gen = fixtures.make_genesis(chain_id, privs)
+    hashes = fixtures.kvstore_app_hashes(N_SRP_BLOCKS)
+    chain = fixtures.build_chain(privs, vs, chain_id, N_SRP_BLOCKS,
+                                 app_hashes=hashes)
+    # a contiguous stale band guarantees the byzantine server gets
+    # asked for at least one tampered height no matter how the pool
+    # splits the request window between the two sources
+    h0 = 8 + ctx.rng("stale-band").randrange(N_SRP_BLOCKS - 14)
+    band = list(range(h0, h0 + 4))
+    byz_sw, _, _ = harness.fastsync_source(chain_id, chain, gen,
+                                           moniker="byz")
+    injectors.tamper_block_server(ctx, byz_sw, chain, "stale", band)
+    honest_sw, _, honest_store = harness.fastsync_source(
+        chain_id, chain, gen, moniker="honest",
+        config=_tcp_source_p2p())
+    sync_sw, bc, _cons, sync_store = harness.fastsync_syncer(
+        chain_id, gen, batch_size=4, fuzz=True)
+    evicted: list = []
+    orig_evict = bc.pool.on_evict
+    bc.pool.on_evict = lambda p, r: (evicted.append((p, r)),
+                                     orig_evict and orig_evict(p, r))
+    for sw in (byz_sw, honest_sw, sync_sw):
+        sw.start()
+    honest_id = honest_sw.node_info.id
+    byz_id = byz_sw.node_info.id
+    # outlast the pool's 3s request timeout so the honest peer is
+    # provably evicted-then-reconnected (see _sever_window)
+    window_s = 4.5
+    ctx.plan("partition-window", window_s=window_s)
+    try:
+        connect_switches(sync_sw, byz_sw)
+        sync_sw.dial_peer_async(
+            NetAddress.parse(str(honest_sw._listener.addr)),
+            persistent=True)
+        connected = harness.wait_until(
+            lambda: sync_sw.get_peer(honest_id) is not None,
+            timeout=15)
+
+        def partition():
+            # engage before verification reaches the stale band, so
+            # the redo path has to ride out the honest-link blackout
+            harness.wait_until(lambda: sync_store.height >= 3,
+                               timeout=30)
+            _sever_window(ctx, sync_sw, honest_id, window_s, 6.0,
+                          "syncer<-honest")
+
+        def delay_byz():
+            link = harness.fuzz_link_to(sync_sw, byz_id)
+            if link is not None:
+                injectors.delay_storm(ctx, [link], delay_prob=0.3,
+                                      max_delay=0.03, label="byz-link")
+
+        sched = ctx.schedule("stale-partition")
+        sched.add("sever-honest", partition, after=0.2, jitter_s=0.4)
+        sched.add("delay-byz", delay_byz, after=0.1, jitter_s=0.3)
+        sched.run(join_timeout_s=90.0)
+        t_sync0 = time.time()
+        synced = harness.wait_until(
+            lambda: sync_store.height >= N_SRP_BLOCKS - 1, timeout=120)
+        sync_s = max(time.time() - t_sync0, 1e-6)
+        chain_ok = all(
+            sync_store.load_block(h).hash()
+            == honest_store.load_block(h).hash()
+            for h in range(1, min(sync_store.height,
+                                  N_SRP_BLOCKS - 2) + 1))
+        byz_banned = sync_sw.is_banned(byz_id)
+        honest_banned = sync_sw.is_banned(honest_id)
+        honest_score = sync_sw.misbehavior_score(honest_id)
+    finally:
         for sw in (byz_sw, honest_sw, sync_sw):
-            sw.start()
-        honest_id = honest_sw.node_info.id
-        byz_id = byz_sw.node_info.id
-        # outlast the pool's 3s request timeout so the honest peer is
-        # provably evicted-then-reconnected (see _sever_window)
-        window_s = 4.5
-        ctx.plan("partition-window", window_s=window_s)
-        try:
-            connect_switches(sync_sw, byz_sw)
-            sync_sw.dial_peer_async(
-                NetAddress.parse(str(honest_sw._listener.addr)),
-                persistent=True)
-            connected = harness.wait_until(
-                lambda: sync_sw.get_peer(honest_id) is not None,
-                timeout=15)
-
-            def partition():
-                # engage before verification reaches the stale band, so
-                # the redo path has to ride out the honest-link blackout
-                harness.wait_until(lambda: sync_store.height >= 3,
-                                   timeout=30)
-                _sever_window(ctx, sync_sw, honest_id, window_s, 6.0,
-                              "syncer<-honest")
-
-            def delay_byz():
-                link = harness.fuzz_link_to(sync_sw, byz_id)
-                if link is not None:
-                    injectors.delay_storm(ctx, [link], delay_prob=0.3,
-                                          max_delay=0.03, label="byz-link")
-
-            sched = ctx.schedule("stale-partition")
-            sched.add("sever-honest", partition, after=0.2, jitter_s=0.4)
-            sched.add("delay-byz", delay_byz, after=0.1, jitter_s=0.3)
-            sched.run(join_timeout_s=90.0)
-            synced = harness.wait_until(
-                lambda: sync_store.height >= N_SRP_BLOCKS - 1, timeout=120)
-            chain_ok = all(
-                sync_store.load_block(h).hash()
-                == honest_store.load_block(h).hash()
-                for h in range(1, min(sync_store.height,
-                                      N_SRP_BLOCKS - 2) + 1))
-            byz_banned = sync_sw.is_banned(byz_id)
-            honest_banned = sync_sw.is_banned(honest_id)
-            honest_score = sync_sw.misbehavior_score(honest_id)
-        finally:
-            for sw in (byz_sw, honest_sw, sync_sw):
-                sw.stop()
+            sw.stop()
     byz_bad_block = any(p == byz_id and r.startswith("bad block")
                         for p, r in evicted)
     ctx.note("stale-partition.result", synced_height=sync_store.height,
@@ -1050,7 +1057,9 @@ def _stale_replay_partition(ctx):
     return {"connected": connected, "synced": synced, "chain_ok": chain_ok,
             "byz_banned": byz_banned, "byz_bad_block": byz_bad_block,
             "honest_banned": honest_banned, "honest_score": honest_score,
-            "synced_height": sync_store.height}
+            "synced_height": sync_store.height,
+            "budget_metrics": {
+                "sync_blocks_per_sec": round(sync_store.height / sync_s, 3)}}
 
 
 def _srp_safety(ctx, obs):
@@ -1095,7 +1104,8 @@ register(
             ("honest-peer-spared", _srp_safety_no_blame)],
     liveness=[("sync-completes", _srp_liveness),
               ("self-healing-evidenced", _srp_liveness_heal)],
-    smoke=False, budget_s=240.0)(_stale_replay_partition)
+    smoke=False, budget_s=240.0,
+    budgets={"sync_blocks_per_sec": {"min": 0.1}})(_stale_replay_partition)
 
 
 # ---------------------------------------------------------------------------
@@ -1231,9 +1241,11 @@ def _partition_heal_25(ctx):
         sched.add("heal", heal, after=0.2, jitter_s=0.2)
         sched.run(join_timeout_s=60.0)
 
+        t_heal0 = time.time()
         reconverged = harness.wait_until(
             lambda: all(sw.n_peers() == N_HEAL_NODES - 1
                         for sw in switches), timeout=120)
+        reconverge_s = time.time() - t_heal0
         if not reconverged:
             ctx.note("heal25.stragglers",
                      peer_counts=[sw.n_peers() for sw in switches])
@@ -1268,7 +1280,9 @@ def _partition_heal_25(ctx):
             "overshoot_max": overshoot["max"],
             "probe_reach": probe_reach, "probe_rcvd": probe_rcvd,
             "crossed": crossed, "ban_held": ban_held,
-            "restored": restored, "unbanned": unbanned}
+            "restored": restored, "unbanned": unbanned,
+            "budget_metrics": {
+                "mesh_reconverge_s": round(reconverge_s, 3)}}
 
 
 def _heal25_safety_cap(ctx, obs):
@@ -1317,7 +1331,8 @@ register(
             ("ban-holds-for-window", _heal25_safety_ban)],
     liveness=[("mesh-reconverges", _heal25_liveness),
               ("ban-expires-and-rejoins", _heal25_liveness_ban_expiry)],
-    smoke=False, budget_s=300.0)(_partition_heal_25)
+    smoke=False, budget_s=300.0,
+    budgets={"mesh_reconverge_s": {"max": 100.0}})(_partition_heal_25)
 
 
 SMOKE_ORDER = ["device-wrong-answer", "evidence-flood",

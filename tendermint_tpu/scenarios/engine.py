@@ -140,6 +140,10 @@ class ScenarioContext:
         self.recorder = tracing.RECORDER
         self.metric_phases: list[dict] = []
         self._rngs: dict[str, object] = {}
+        # the installed crypto backend object for this run (run_scenario
+        # sets it): bodies drive ladder probes / attach chaos through it
+        self.backend = None
+        self.backend_name: str | None = None
 
     # -- derived randomness ---------------------------------------------
     def derive_seed(self, *labels: str) -> int:
@@ -261,6 +265,65 @@ class InjectorSchedule:
                 f"{type(exc).__name__}: {exc}") from exc
 
 
+# -- scenario crypto backends ----------------------------------------------
+#
+# Rigs are backend-parametric: every scenario declares a default rung
+# (python unless it says otherwise) and TM_SCENARIO_BACKEND / an explicit
+# run_scenario(backend=...) override walks the SupervisedBackend ladder
+# instead.  "python" is a bare PythonBackend (bit-deterministic smoke
+# tier); "tpu"/"ladder" and "native" build the supervised ladder starting
+# at that rung (skipping unavailable rungs, always ending on the python
+# floor); "rig" is a two-rung supervised ladder whose device-role rung is
+# a PythonBackend — the deterministic chaos-capable ladder big rigs run
+# on hardware-free CI, with the same breaker/demotion machinery as the
+# real device ladder.
+KNOWN_BACKENDS = ("python", "tpu", "native", "ladder", "rig")
+DEFAULT_SCENARIO_BACKEND = "python"
+SCENARIO_BACKEND_ENV = "TM_SCENARIO_BACKEND"
+
+
+def resolve_backend(sc: "Scenario", override: str | None = None) -> str:
+    """Precedence: explicit override > TM_SCENARIO_BACKEND > the
+    scenario's declared default."""
+    name = (override or os.environ.get(SCENARIO_BACKEND_ENV, "").strip()
+            or sc.backend)
+    if name not in KNOWN_BACKENDS:
+        raise ValueError(f"unknown scenario backend {name!r} "
+                         f"(known: {sorted(KNOWN_BACKENDS)})")
+    return name
+
+
+def _make_scenario_backend(name: str):
+    from tendermint_tpu.crypto import backend as cb
+    from tendermint_tpu.crypto.supervised import SupervisedBackend
+    if name == "python":
+        return cb.PythonBackend()
+    if name == "rig":
+        return SupervisedBackend(
+            [("dev", cb.PythonBackend()), ("python", cb.PythonBackend())],
+            breaker_threshold=2, breaker_cooldown_s=0.5,
+            retries=0, call_timeout_s=30.0)
+    primary = "tpu" if name == "ladder" else name
+    return SupervisedBackend.build(primary)
+
+
+@contextlib.contextmanager
+def scenario_backend(name: str):
+    """Install the resolved backend as the process-wide crypto backend
+    for the duration of a scenario run; yields the backend object (also
+    exposed as ctx.backend so bodies can drive ladder probes)."""
+    from tendermint_tpu.crypto import backend as cb
+    be = _make_scenario_backend(name)
+    with cb._lock:
+        old = cb._current
+        cb._current = be
+    try:
+        yield be
+    finally:
+        with cb._lock:
+            cb._current = old
+
+
 class Scenario:
     """A registered scenario: body + named safety/liveness invariants.
 
@@ -272,7 +335,9 @@ class Scenario:
 
     def __init__(self, name: str, description: str, body,
                  safety: list, liveness: list, smoke: bool = False,
-                 budget_s: float | None = None):
+                 budget_s: float | None = None,
+                 backend: str | None = None,
+                 budgets: dict | None = None):
         if not safety or not liveness:
             raise ValueError(
                 f"scenario {name!r} needs >=1 safety and >=1 liveness "
@@ -289,6 +354,20 @@ class Scenario:
         # like a correctness regression
         self.budget_s = float(budget_s) if budget_s is not None else (
             DEFAULT_SMOKE_BUDGET_S if smoke else DEFAULT_STRESS_BUDGET_S)
+        # default crypto backend for the rig (see KNOWN_BACKENDS);
+        # python keeps the smoke tier deterministic, big rigs declare a
+        # supervised ladder, TM_SCENARIO_BACKEND overrides at run time
+        self.backend = backend or DEFAULT_SCENARIO_BACKEND
+        if self.backend not in KNOWN_BACKENDS:
+            raise ValueError(
+                f"scenario {name!r}: unknown backend {self.backend!r} "
+                f"(known: {sorted(KNOWN_BACKENDS)})")
+        # metric-level budgets alongside the wall-clock one: each entry
+        # maps a metric the body reports (obs['budget_metrics'][name] or
+        # obs[name]) to a bound — a bare number is a max, or an explicit
+        # {'max': x} / {'min': x}.  A violated OR MISSING metric is a
+        # budget breach, ledgered per seed like the wall-clock budget.
+        self.budgets = _normalize_budgets(name, budgets)
 
 
 # default declared budgets (seconds per run) when a scenario doesn't
@@ -299,8 +378,31 @@ DEFAULT_STRESS_BUDGET_S = 420.0
 SCENARIOS: dict[str, Scenario] = {}
 
 
+def _normalize_budgets(name: str, budgets: dict | None) -> dict:
+    """Validate + canonicalize a metric-budget declaration into
+    {metric: {"max": float} | {"min": float} | both}."""
+    out: dict[str, dict] = {}
+    for metric, spec in (budgets or {}).items():
+        if isinstance(spec, bool) or not isinstance(
+                spec, (int, float, dict)):
+            raise ValueError(
+                f"scenario {name!r}: budget for {metric!r} must be a "
+                f"number (max) or a {{'max'/'min': number}} dict, "
+                f"got {spec!r}")
+        if isinstance(spec, dict):
+            if not spec or not set(spec) <= {"max", "min"}:
+                raise ValueError(
+                    f"scenario {name!r}: budget for {metric!r} allows "
+                    f"only 'max'/'min' keys, got {sorted(spec)}")
+            out[metric] = {k: float(v) for k, v in spec.items()}
+        else:
+            out[metric] = {"max": float(spec)}
+    return out
+
+
 def register(name: str, description: str, safety: list, liveness: list,
-             smoke: bool = False, budget_s: float | None = None):
+             smoke: bool = False, budget_s: float | None = None,
+             backend: str | None = None, budgets: dict | None = None):
     """Decorator: `@register("byz-equivocation", "...", safety=[...],
     liveness=[...])` over the scenario body."""
     def deco(fn):
@@ -308,7 +410,8 @@ def register(name: str, description: str, safety: list, liveness: list,
             raise ValueError(f"duplicate scenario {name!r}")
         SCENARIOS[name] = Scenario(name, description, fn,
                                    safety, liveness, smoke=smoke,
-                                   budget_s=budget_s)
+                                   budget_s=budget_s, backend=backend,
+                                   budgets=budgets)
         return fn
     return deco
 
@@ -318,7 +421,9 @@ class ScenarioResult:
                  event_log_hash: str, duration_s: float,
                  observations: dict, artifact_dir: str | None,
                  budget_s: float | None = None,
-                 budget_breaches: list[str] | None = None):
+                 budget_breaches: list[str] | None = None,
+                 backend: str | None = None,
+                 budget_metrics: dict | None = None):
         self.name = name
         self.seed = seed
         self.ok = ok
@@ -331,6 +436,10 @@ class ScenarioResult:
         # breaches are tracked apart from invariant failures: the run's
         # VERDICT stays about correctness, but soak exits nonzero on both
         self.budget_breaches = list(budget_breaches or [])
+        self.backend = backend
+        # per-metric verdicts: {metric: {value, max?, min?, ok}} — what
+        # the per-seed chaos-ledger entries carry next to the wall clock
+        self.budget_metrics = dict(budget_metrics or {})
 
     def to_dict(self) -> dict:
         return {"scenario": self.name, "seed": self.seed, "ok": self.ok,
@@ -339,6 +448,8 @@ class ScenarioResult:
                 "duration_s": round(self.duration_s, 3),
                 "budget_s": self.budget_s,
                 "budget_breaches": self.budget_breaches,
+                "backend": self.backend,
+                "budget_metrics": _json_safe(self.budget_metrics),
                 "observations": _json_safe(self.observations),
                 "artifact_dir": self.artifact_dir}
 
@@ -378,56 +489,101 @@ def _dump_artifacts(ctx: ScenarioContext, result: ScenarioResult,
     return d
 
 
+def _check_metric_budgets(sc: Scenario, obs: dict) -> tuple[list[str], dict]:
+    """Evaluate the scenario's declared metric budgets against the
+    body's reported values.  Returns (breach strings, per-metric
+    verdicts).  A metric the body failed to report is itself a breach —
+    a budget that silently stopped being measured must not read as
+    green."""
+    breaches: list[str] = []
+    verdicts: dict[str, dict] = {}
+    reported = obs.get("budget_metrics") or {}
+    for metric, spec in sc.budgets.items():
+        val = reported.get(metric, obs.get(metric))
+        verdict = dict(spec)
+        if isinstance(val, bool) or not isinstance(val, (int, float)):
+            verdict.update(value=None, ok=False)
+            breaches.append(
+                f"metric {metric} missing from observations "
+                f"(declared budget {spec}) — the budget was not measured")
+        else:
+            ok = True
+            if "max" in spec and val > spec["max"]:
+                ok = False
+                breaches.append(f"metric {metric}={val:g} over declared "
+                                f"max {spec['max']:g}")
+            if "min" in spec and val < spec["min"]:
+                ok = False
+                breaches.append(f"metric {metric}={val:g} under declared "
+                                f"min {spec['min']:g}")
+            verdict.update(value=val, ok=ok)
+        verdicts[metric] = verdict
+    return breaches, verdicts
+
+
 def run_scenario(name: str, seed: int = DEFAULT_SEED,
                  artifacts: str | None = None,
-                 keep_artifacts: bool = False) -> ScenarioResult:
-    """Run one registered scenario end to end: install the ChaosConfig,
-    execute the body, snapshot metrics, run the safety+liveness
-    post-mortem, and dump artifacts on failure (always, when
-    `keep_artifacts`).  Never raises on scenario failure — the result
-    carries the verdict; raises only on unknown scenario names."""
+                 keep_artifacts: bool = False,
+                 backend: str | None = None) -> ScenarioResult:
+    """Run one registered scenario end to end: install the ChaosConfig
+    and the resolved crypto backend, execute the body, snapshot metrics,
+    run the safety+liveness post-mortem, check wall-clock and metric
+    budgets, and dump artifacts on any failure OR budget breach (always,
+    when `keep_artifacts`).  Never raises on scenario failure — the
+    result carries the verdict; raises only on unknown scenario or
+    backend names."""
     sc = SCENARIOS.get(name)
     if sc is None:
         raise KeyError(f"unknown scenario {name!r}; "
                        f"known: {sorted(SCENARIOS)}")
+    backend_name = resolve_backend(sc, backend)
     ctx = ScenarioContext(sc, seed)
     ctx.plan("scenario", name=name, seed=seed)
+    # part of the hashed schedule: a replay on a different rung is a
+    # DIFFERENT experiment and must not report MATCH
+    ctx.plan("backend", name=backend_name)
+    ctx.backend_name = backend_name
     prev_cfg = chaosmod.install(chaosmod.ChaosConfig(seed=seed))
     failures: list[str] = []
     obs: dict = {}
     t0 = time.perf_counter()
     ctx.snapshot_metrics("start")
     try:
-        with ctx.recorder.span("scenario.run", cat=tracing.CAT_NONE,
-                               scenario=name, seed=seed):
-            try:
-                obs = sc.body(ctx) or {}
-            except InvariantViolation as e:
-                failures.append(f"body: {e}")
-            except Exception as e:  # noqa: BLE001 - the post-mortem must
-                # still run and the trace must still dump on ANY failure
-                log.error("scenario body crashed", scenario=name,
-                          error=f"{type(e).__name__}: {e}")
-                failures.append(f"body: {type(e).__name__}: {e}")
-        ctx.snapshot_metrics("end")
-        for kind, invariants in (("safety", sc.safety),
-                                 ("liveness", sc.liveness)):
-            for inv_name, fn in invariants:
+        with scenario_backend(backend_name) as be:
+            ctx.backend = be
+            with ctx.recorder.span("scenario.run", cat=tracing.CAT_NONE,
+                                   scenario=name, seed=seed):
                 try:
-                    fn(ctx, obs)
-                    ctx.note("invariant", name=inv_name, kind=kind,
-                             ok=True)
-                except AssertionError as e:
-                    failures.append(f"{kind}:{inv_name}: {e}")
-                    ctx.note("invariant", name=inv_name, kind=kind,
-                             ok=False, error=str(e))
-                except Exception as e:  # noqa: BLE001 - an invariant that
-                    # crashes is a failed invariant, not a passed one
-                    failures.append(
-                        f"{kind}:{inv_name}: {type(e).__name__}: {e}")
-                    ctx.note("invariant", name=inv_name, kind=kind,
-                             ok=False, error=f"{type(e).__name__}: {e}")
+                    obs = sc.body(ctx) or {}
+                except InvariantViolation as e:
+                    failures.append(f"body: {e}")
+                except Exception as e:  # noqa: BLE001 - the post-mortem
+                    # must still run and the trace must still dump on ANY
+                    # failure
+                    log.error("scenario body crashed", scenario=name,
+                              error=f"{type(e).__name__}: {e}")
+                    failures.append(f"body: {type(e).__name__}: {e}")
+            ctx.snapshot_metrics("end")
+            for kind, invariants in (("safety", sc.safety),
+                                     ("liveness", sc.liveness)):
+                for inv_name, fn in invariants:
+                    try:
+                        fn(ctx, obs)
+                        ctx.note("invariant", name=inv_name, kind=kind,
+                                 ok=True)
+                    except AssertionError as e:
+                        failures.append(f"{kind}:{inv_name}: {e}")
+                        ctx.note("invariant", name=inv_name, kind=kind,
+                                 ok=False, error=str(e))
+                    except Exception as e:  # noqa: BLE001 - an invariant
+                        # that crashes is a failed invariant, not a
+                        # passed one
+                        failures.append(
+                            f"{kind}:{inv_name}: {type(e).__name__}: {e}")
+                        ctx.note("invariant", name=inv_name, kind=kind,
+                                 ok=False, error=f"{type(e).__name__}: {e}")
     finally:
+        ctx.backend = None
         chaosmod.install(prev_cfg)
     duration_s = time.perf_counter() - t0
     breaches: list[str] = []
@@ -435,16 +591,22 @@ def run_scenario(name: str, seed: int = DEFAULT_SEED,
         breaches.append(
             f"wall-clock {duration_s:.1f}s over declared budget "
             f"{sc.budget_s:.1f}s")
+    metric_breaches, budget_metrics = _check_metric_budgets(sc, obs)
+    breaches.extend(metric_breaches)
     result = ScenarioResult(
         name=name, seed=seed, ok=not failures, failures=failures,
         event_log_hash=ctx.log.hash(),
         duration_s=duration_s,
         observations=obs, artifact_dir=None,
-        budget_s=sc.budget_s, budget_breaches=breaches)
+        budget_s=sc.budget_s, budget_breaches=breaches,
+        backend=backend_name, budget_metrics=budget_metrics)
     if breaches:
-        log.warning("scenario over budget", scenario=name, seed=seed,
-                    duration_s=round(duration_s, 1), budget_s=sc.budget_s)
-    if failures or keep_artifacts:
+        log.warn("scenario over budget", scenario=name, seed=seed,
+                    duration_s=round(duration_s, 1), budget_s=sc.budget_s,
+                    breaches=len(breaches))
+    # a budget breach files the same durable triage bundle an invariant
+    # failure does: nightly CI red must always leave the evidence behind
+    if failures or breaches or keep_artifacts:
         try:
             result.artifact_dir = _dump_artifacts(
                 ctx, result, artifacts_root(artifacts))
@@ -459,6 +621,10 @@ def run_scenario(name: str, seed: int = DEFAULT_SEED,
 # -- seed-sweep soak ------------------------------------------------------
 
 CHAOS_LEDGER_SCHEMA = "tpu-bft-chaos-ledger/1"
+# one line per (scenario, seed) run: the per-seed budget verdicts
+# (commit_latency_p99, rounds_per_height, ...) next to the wall clock,
+# so a single seed's regression is greppable without re-running the sweep
+CHAOS_RUN_SCHEMA = "tpu-bft-chaos-run/1"
 DEFAULT_CHAOS_LEDGER = "CHAOS_LEDGER.jsonl"
 
 
@@ -484,14 +650,17 @@ def parse_seed_range(spec: str) -> list[int]:
 def run_sweep(names: list[str], seeds: list[int],
               artifacts: str | None = None, keep_artifacts: bool = False,
               ledger_path: str | None = None,
-              progress=None) -> dict:
+              progress=None, backend: str | None = None) -> dict:
     """Soak: run every scenario in `names` across every seed in `seeds`,
     aggregate per-scenario stats, and (unless `ledger_path` is None)
-    append a chaos-ledger entry whose per-scenario `runs_per_sec` rate
-    plugs into `utils.ledger.compute_deltas` — a fault-path latency
-    regression shows up in `cli chaos soak` history exactly like a bench
-    regression.  `progress`, when given, is called with each
-    ScenarioResult as it lands (never-silent soak reporting)."""
+    append one per-run chaos-ledger line per (scenario, seed) — carrying
+    the metric-budget verdicts — plus an aggregate entry whose
+    per-scenario `runs_per_sec` rate plugs into
+    `utils.ledger.compute_deltas`: a fault-path latency regression shows
+    up in `cli chaos soak` history exactly like a bench regression.
+    `progress`, when given, is called with each ScenarioResult as it
+    lands (never-silent soak reporting).  `backend` overrides every
+    scenario's declared crypto rung for the whole sweep."""
     unknown = [n for n in names if n not in SCENARIOS]
     if unknown:
         raise KeyError(f"unknown scenarios {unknown}; "
@@ -507,7 +676,8 @@ def run_sweep(names: list[str], seeds: list[int],
     for n in names:
         for seed in seeds:
             r = run_scenario(n, seed=seed, artifacts=artifacts,
-                             keep_artifacts=keep_artifacts)
+                             keep_artifacts=keep_artifacts,
+                             backend=backend)
             results.append(r)
             a = agg[n]
             a["runs"] += 1
@@ -542,9 +712,20 @@ def run_sweep(names: list[str], seeds: list[int],
     }
     if ledger_path is not None:
         from tendermint_tpu.utils import ledger as ledgermod
+        stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        for r in results:
+            ledgermod.append_entry(ledger_path, {
+                "schema": CHAOS_RUN_SCHEMA, "scenario": r.name,
+                "seed": r.seed, "ok": r.ok, "backend": r.backend,
+                "duration_s": round(r.duration_s, 3),
+                "budget_s": r.budget_s,
+                "budget_breaches": r.budget_breaches,
+                "budget_metrics": _json_safe(r.budget_metrics),
+                "event_log_hash": r.event_log_hash,
+                "artifact_dir": r.artifact_dir,
+                "timestamp": stamp})
         entry = dict(summary)
-        entry["timestamp"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
-                                           time.gmtime())
+        entry["timestamp"] = stamp
         prior = [e for e in ledgermod.load(ledger_path)
                  if e.get("schema") == CHAOS_LEDGER_SCHEMA]
         summary["deltas"] = ledgermod.compute_deltas(prior, configs)

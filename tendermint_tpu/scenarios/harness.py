@@ -19,6 +19,7 @@ grew organically in `tests/test_consensus.py` / `test_fastsync.py` /
 
 from __future__ import annotations
 
+import threading
 import time
 
 from tendermint_tpu.blockchain.reactor import BlockchainReactor
@@ -50,16 +51,22 @@ def wait_until(pred, timeout: float, poll: float = 0.02) -> bool:
 # -- wire net (no transport) ------------------------------------------------
 
 class WireNode:
-    """ConsensusState + mempool + store, broadcast_cb-wired."""
+    """ConsensusState + mempool + store, broadcast_cb-wired.
+
+    `state`, `conns` and `block_store` are injectable so a restart rig
+    (WireMesh) can rebuild a node over a retained block store with an
+    app replayed back to the crash height."""
 
     def __init__(self, priv, gen, cfg: Config | None = None,
-                 app: str = "kvstore", wal_path: str = ""):
+                 app: str = "kvstore", wal_path: str = "",
+                 state=None, conns=None, block_store=None):
         cfg = cfg or test_config()
         self.priv = priv
-        st = get_state(MemDB(), gen)
-        self.conns = ClientCreator(app).new_app_conns()
+        st = state if state is not None else get_state(MemDB(), gen)
+        self.conns = conns or ClientCreator(app).new_app_conns()
         self.mempool = Mempool(self.conns.mempool)
-        self.block_store = BlockStore(MemDB())
+        self.block_store = (block_store if block_store is not None
+                            else BlockStore(MemDB()))
         self.cs = ConsensusState(cfg.consensus, st, self.conns.consensus,
                                  self.block_store, self.mempool,
                                  priv_validator=priv, wal_path=wal_path)
@@ -100,6 +107,189 @@ def start_wire_net(nodes: list[WireNode], stagger_s: float = 0.0) -> None:
         nd.cs.start()
         if stagger_s > 0.0 and i < len(nodes) - 1:
             time.sleep(stagger_s)
+
+
+class WireMesh:
+    """Partitionable wire mesh: the 50-100 validator live-consensus rig.
+
+    Same no-transport delivery as `wire_net`, with the link matrix made
+    explicit so chaos schedules can cut/heal node pairs and
+    crash/restart nodes mid-round:
+
+    - `isolate(victims)` cuts every victim<->survivor link (the victims
+      keep talking among themselves — an island partition); `heal()`
+      restores the full mesh.
+    - `crash(i)` stops a node's consensus thread; `restart(i)` rebuilds
+      it over its RETAINED block store, replaying the committed prefix
+      through a fresh app so state/app stay consistent.
+
+    Wire delivery has no catchup gossip: a node that misses commits
+    while down or severed stays permanently behind the quorum (votes
+    for heights it has not reached are dropped).  Scenario invariants
+    must therefore assert QUORUM liveness plus committed-prefix
+    agreement, and adversary schedules must keep >=2/3 of the voting
+    power live and connected.
+
+    A sampler thread timestamps every height the live quorum commits,
+    so scenarios can assert metric budgets (commit latency percentiles)
+    instead of only wall-clock.
+    """
+
+    def __init__(self, chain_id: str, n: int, seed: int = 0,
+                 timeouts: dict[str, float] | None = None,
+                 app: str = "kvstore"):
+        self.chain_id = chain_id
+        self.n = n
+        self.app = app
+        self._timeouts = timeouts
+        self.privs, _vs = fixtures.make_validators(n, seed=seed)
+        self.gen = fixtures.make_genesis(chain_id, self.privs)
+        self._lock = threading.Lock()
+        self._down: set[int] = set()
+        self._cut: set[frozenset[int]] = set()
+        self.store_dbs = [MemDB() for _ in range(n)]
+        self.nodes: list[WireNode] = [self._build(i) for i in range(n)]
+        for i in range(n):
+            self.nodes[i].cs.broadcast_cb = self._make_cb(i)
+        self.restarts = 0
+        self._samples: list[tuple[int, float]] = []   # (height, t_seen)
+        self._sampler: threading.Thread | None = None
+        self._sampler_stop = threading.Event()
+
+    # -- construction / restart ----------------------------------------
+
+    def _build(self, i: int) -> WireNode:
+        """(Re)build node `i` over its retained block store.  The app
+        conns are fresh, so the committed prefix is replayed through
+        them — a from-disk restart without WAL, driven by the store."""
+        store = BlockStore(self.store_dbs[i])
+        st = get_state(MemDB(), self.gen)
+        conns = ClientCreator(self.app).new_app_conns()
+        for h in range(1, store.height + 1):
+            block = store.load_block(h)
+            meta = store.load_block_meta(h)
+            execution.apply_block(st, None, conns.consensus, block,
+                                  meta.block_id.parts,
+                                  execution.MockMempool(),
+                                  check_last_commit=False)
+        return WireNode(self.privs[i], self.gen,
+                        cfg=config_with_timeouts(self._timeouts),
+                        app=self.app, state=st, conns=conns,
+                        block_store=store)
+
+    def _make_cb(self, me_i: int):
+        def cb(msg):
+            with self._lock:
+                if me_i in self._down:
+                    return
+                down = set(self._down)
+                cut = set(self._cut)
+                nodes = list(self.nodes)
+            for j, other in enumerate(nodes):
+                if j == me_i or j in down:
+                    continue
+                if frozenset((me_i, j)) in cut:
+                    continue
+                if isinstance(msg, M.VoteMessage):
+                    other.cs.add_vote(msg.vote, peer_id="net")
+                elif isinstance(msg, M.ProposalMessage):
+                    other.cs.set_proposal(msg.proposal, peer_id="net")
+                elif isinstance(msg, M.BlockPartMessage):
+                    other.cs.add_proposal_block_part(
+                        msg.height, msg.round, msg.part, peer_id="net")
+        return cb
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self) -> None:
+        for nd in self.nodes:
+            nd.cs.start()
+
+    def stop(self) -> None:
+        self.stop_sampler()
+        with self._lock:
+            self._down.update(range(self.n))
+        for nd in self.nodes:
+            nd.cs.stop()
+
+    def crash(self, i: int) -> None:
+        """SIGKILL-shaped: mark the node dead FIRST (so no sender can
+        block on its dead queue), then stop its consensus thread."""
+        with self._lock:
+            self._down.add(i)
+        self.nodes[i].cs.stop()
+
+    def restart(self, i: int) -> None:
+        node = self._build(i)
+        node.cs.broadcast_cb = self._make_cb(i)
+        with self._lock:
+            self.nodes[i] = node
+            self._down.discard(i)
+        node.cs.start()
+        self.restarts += 1
+
+    # -- partitions -----------------------------------------------------
+
+    def isolate(self, victims: list[int]) -> None:
+        vs = set(victims)
+        with self._lock:
+            for v in vs:
+                for j in range(self.n):
+                    if j not in vs:
+                        self._cut.add(frozenset((v, j)))
+
+    def heal(self) -> None:
+        with self._lock:
+            self._cut.clear()
+
+    # -- observation ----------------------------------------------------
+
+    def live(self) -> list[int]:
+        with self._lock:
+            return [i for i in range(self.n) if i not in self._down]
+
+    def stores(self) -> list:
+        return [nd.block_store for nd in self.nodes]
+
+    def quorum_height(self) -> int:
+        """Max committed height across live nodes (0 when all down)."""
+        with self._lock:
+            nodes = [nd for i, nd in enumerate(self.nodes)
+                     if i not in self._down]
+        return max((nd.block_store.height for nd in nodes), default=0)
+
+    def start_sampler(self, poll_s: float = 0.05) -> None:
+        def run():
+            last_h = self.quorum_height()
+            while not self._sampler_stop.is_set():
+                h = self.quorum_height()
+                if h > last_h:
+                    now = time.time()
+                    for hh in range(last_h + 1, h + 1):
+                        self._samples.append((hh, now))
+                    last_h = h
+                time.sleep(poll_s)
+        self._sampler_stop.clear()
+        self._sampler = threading.Thread(target=run, daemon=True,
+                                         name="wiremesh-sampler")
+        self._sampler.start()
+
+    def stop_sampler(self) -> None:
+        self._sampler_stop.set()
+        if self._sampler is not None:
+            self._sampler.join(timeout=5)
+            self._sampler = None
+
+    def commit_latencies(self) -> list[float]:
+        """Gaps between consecutive sampled commits (seconds)."""
+        ts = [t for _h, t in self._samples]
+        return [b - a for a, b in zip(ts, ts[1:])]
+
+    def commit_latency_p99(self) -> float | None:
+        gaps = sorted(self.commit_latencies())
+        if not gaps:
+            return None
+        return gaps[min(len(gaps) - 1, int(0.99 * len(gaps)))]
 
 
 # -- fast-sync rig ----------------------------------------------------------

@@ -33,6 +33,24 @@ def no_conflicting_commits(stores: list, upto: int | None = None) -> None:
                 f"{sorted(x.hex()[:16] for x in hashes)}")
 
 
+def prefix_agreement(stores: list) -> None:
+    """Agreement over each store's OWN committed prefix: every block a
+    store committed matches the block the furthest-ahead store committed
+    at that height.  Unlike `no_conflicting_commits` (which only checks
+    up to the MINIMUM height), this catches a stale straggler that
+    committed a divergent block before falling behind — the live-rig
+    shape, where partitioned/crashed nodes legitimately trail the
+    quorum but must never disagree with it."""
+    ref = max(stores, key=lambda s: s.height)
+    for s in stores:
+        for h in range(1, s.height + 1):
+            got, want = s.load_block(h).hash(), ref.load_block(h).hash()
+            require(got == want,
+                    f"prefix divergence at height {h}: a node committed "
+                    f"{got.hex()[:16]}, the quorum committed "
+                    f"{want.hex()[:16]}")
+
+
 def chains_match(store, ref_store, upto: int) -> None:
     """The synced chain is byte-identical to the honest reference."""
     for h in range(1, upto + 1):

@@ -16,6 +16,7 @@ cold.  Batch verification goes through `tendermint_tpu.crypto.backend`.
 
 from __future__ import annotations
 
+import functools
 import hashlib
 import secrets
 from dataclasses import dataclass
@@ -24,6 +25,22 @@ from tendermint_tpu.crypto import pure_ed25519 as _ed
 from tendermint_tpu.crypto import native as _native
 
 ADDRESS_LEN = 20
+
+# Ed25519 verification is a pure function of (pubkey, msg, sig), so its
+# result can be memoized soundly.  In-process multi-node rigs (the
+# 50-100 validator scenario meshes) hand the SAME wire vote to every
+# node: without the memo each of N nodes pays a full scalar verify for
+# every vote (N x quadratic work under the GIL); with it the first
+# verify settles the question process-wide.  Production single-node
+# topology sees only the cost of one dict lookup per repeat.
+_VERIFY_MEMO_SIZE = 1 << 16
+
+
+@functools.lru_cache(maxsize=_VERIFY_MEMO_SIZE)
+def _verify_memo(pub: bytes, msg: bytes, sig: bytes) -> bool:
+    if _native.AVAILABLE:
+        return _native.verify_one(pub, msg, sig)
+    return _ed.verify(pub, msg, sig)
 
 
 def address_from_pubkey(pub: bytes) -> bytes:
@@ -50,9 +67,7 @@ class PubKey:
         return a
 
     def verify(self, msg: bytes, sig: bytes) -> bool:
-        if _native.AVAILABLE:
-            return _native.verify_one(self.bytes_, msg, sig)
-        return _ed.verify(self.bytes_, msg, sig)
+        return _verify_memo(self.bytes_, msg, sig)
 
     def hex(self) -> str:
         return self.bytes_.hex()

@@ -1,0 +1,255 @@
+"""Live big-rig mechanics (tier-1 twin of scenarios/live.py).
+
+The 50-100 validator scenarios are stress-tier; what's pinned here at
+tier-1 speed is the machinery they stand on:
+
+- WireMesh: commit progress, island partitions, crash/restart over the
+  retained store (committed-prefix replay through a fresh app), the
+  commit-latency sampler, and prefix agreement as the safety invariant
+- the receive-loop's mid-round DeviceFault handling: a vote burst whose
+  grouped pre-verify dies on an exhausted crypto ladder falls back to
+  the scalar path with every vote counted exactly once — an infra
+  fault must never drop or double-count honest votes
+"""
+
+import time
+
+import pytest
+
+from tendermint_tpu.crypto import backend as cb
+from tendermint_tpu.scenarios import harness
+from tendermint_tpu.scenarios import invariants as inv
+
+pytestmark = pytest.mark.faults
+
+CHAIN = "live-rig-chain"
+
+
+@pytest.fixture(autouse=True)
+def scalar_backend():
+    """Pin the python backend for the mesh: a lazily-constructed device
+    backend would pay its table build under the backend lock inside a
+    consensus thread, wedging every node in the rig."""
+    prev = cb._current
+    cb._current = cb.PythonBackend()
+    try:
+        yield
+    finally:
+        cb._current = prev
+
+
+def _mesh(n=4, **kw):
+    return harness.WireMesh(CHAIN, n, seed=3, **kw)
+
+
+def test_wiremesh_commits_with_prefix_agreement():
+    mesh = _mesh()
+    # sampler first: started after the mesh it can lose the first
+    # heights to scheduling lag and under-sample the run
+    mesh.start_sampler(poll_s=0.01)
+    mesh.start()
+    try:
+        assert harness.wait_until(lambda: mesh.quorum_height() >= 3,
+                                  timeout=60)
+        # the sampler trails the quorum by up to one poll
+        assert harness.wait_until(lambda: len(mesh._samples) >= 3,
+                                  timeout=10)
+    finally:
+        mesh.stop()
+    inv.prefix_agreement(mesh.stores())
+    # the sampler saw the commits it claims latencies for
+    assert len(mesh._samples) >= 3
+    assert all(g >= 0 for g in mesh.commit_latencies())
+    assert mesh.commit_latency_p99() is not None
+
+
+def test_wiremesh_island_partition_keeps_quorum_live():
+    """Cutting a 1-node island out of 4 leaves 3/4 > 2/3 voting power:
+    the quorum keeps committing while the victim stalls, and after heal
+    every store still agrees on its committed prefix."""
+    mesh = _mesh()
+    mesh.start()
+    try:
+        assert harness.wait_until(lambda: mesh.quorum_height() >= 1,
+                                  timeout=60)
+        mesh.isolate([3])
+        h0 = mesh.quorum_height()
+        victim_h0 = mesh.nodes[3].block_store.height
+        assert harness.wait_until(
+            lambda: mesh.quorum_height() >= h0 + 2, timeout=60)
+        # the severed node saw none of those commits
+        assert mesh.nodes[3].block_store.height <= victim_h0 + 1
+        mesh.heal()
+        h1 = mesh.quorum_height()
+        assert harness.wait_until(
+            lambda: mesh.quorum_height() >= h1 + 1, timeout=60)
+    finally:
+        mesh.stop()
+    inv.prefix_agreement(mesh.stores())
+
+
+def test_wiremesh_crash_restart_replays_retained_prefix():
+    """A crash-restart rebuilds the node OVER its retained block store:
+    the committed prefix is replayed through a fresh app (state and
+    app-hash stay consistent) and the node rejoins without ever
+    disagreeing with the quorum."""
+    mesh = _mesh()
+    mesh.start()
+    try:
+        assert harness.wait_until(lambda: mesh.quorum_height() >= 2,
+                                  timeout=60)
+        mesh.crash(1)
+        assert 1 not in mesh.live()
+        h_store = mesh.nodes[1].block_store.height
+        h0 = mesh.quorum_height()
+        # the quorum keeps going without the crashed node
+        assert harness.wait_until(
+            lambda: mesh.quorum_height() >= h0 + 1, timeout=60)
+        mesh.restart(1)
+        assert 1 in mesh.live() and mesh.restarts == 1
+        # the rebuilt node starts from its own committed prefix, and its
+        # replayed state matches the store it was rebuilt over
+        nd = mesh.nodes[1]
+        assert nd.block_store.height >= h_store
+        assert nd.cs.state.last_block_height == nd.block_store.height
+        h1 = mesh.quorum_height()
+        assert harness.wait_until(
+            lambda: mesh.quorum_height() >= h1 + 1, timeout=60)
+    finally:
+        mesh.stop()
+    inv.prefix_agreement(mesh.stores())
+
+
+def test_prefix_agreement_catches_divergent_straggler():
+    """The invariant itself: a stale node that committed a DIFFERENT
+    block before falling behind must fail prefix agreement even though
+    `no_conflicting_commits` over the common prefix would... also see
+    it — the point is the straggler's whole prefix is checked against
+    the furthest-ahead store."""
+    mesh = _mesh(n=3)
+    mesh.start()
+    try:
+        assert harness.wait_until(lambda: mesh.quorum_height() >= 2,
+                                  timeout=60)
+    finally:
+        mesh.stop()
+    inv.prefix_agreement(mesh.stores())
+
+    class FakeStore:
+        height = 1
+
+        def load_block(self, h):
+            class B:
+                def hash(self):
+                    return b"\xde\xad" * 16
+            return B()
+
+    from tendermint_tpu.scenarios.engine import InvariantViolation
+    with pytest.raises(InvariantViolation, match="prefix divergence"):
+        inv.prefix_agreement(mesh.stores() + [FakeStore()])
+
+
+# -- mid-round DeviceFault in the vote path ---------------------------------
+
+
+def _vote_burst(n_vals=20):
+    """An observer ConsensusState in (height 1, round 0) plus a vote run
+    spanning a round boundary: a full precommit set for round 0 and two
+    early prevotes for round 1."""
+    from chainutil import make_genesis, make_validators, sign_vote
+    from tendermint_tpu.blockchain.store import BlockStore
+    from tendermint_tpu.config import test_config
+    from tendermint_tpu.consensus import messages as M
+    from tendermint_tpu.consensus.state import ConsensusState
+    from tendermint_tpu.mempool.mempool import Mempool
+    from tendermint_tpu.proxy import ClientCreator
+    from tendermint_tpu.state.state import get_state
+    from tendermint_tpu.types import BlockID, PartSetHeader
+    from tendermint_tpu.utils.db import MemDB
+
+    privs, vs = make_validators(n_vals)
+    gen = make_genesis(CHAIN, privs)
+    conns = ClientCreator("kvstore").new_app_conns()
+    cs = ConsensusState(test_config().consensus, get_state(MemDB(), gen),
+                        conns.consensus, BlockStore(MemDB()),
+                        Mempool(conns.mempool))
+    cs._replay_mode = True             # no WAL; direct driving
+    cs._enter_new_round(1, 0)
+    bid = BlockID(b"\x11" * 32, PartSetHeader(1, b"\x22" * 32))
+    run = [(M.VoteMessage(sign_vote(p, vs, CHAIN, 1, 0, 2, bid)), "peer")
+           for p in privs]
+    run += [(M.VoteMessage(sign_vote(p, vs, CHAIN, 1, 1, 1, bid)), "peer")
+            for p in privs[:2]]
+    return cs, bid, run, n_vals
+
+
+def test_vote_burst_device_fault_falls_back_to_scalar(monkeypatch):
+    """The regression the live rigs rely on: a crypto storm at a round
+    boundary exhausts the whole supervised ladder mid-burst, the
+    grouped pre-verify surfaces DeviceFault — and the receive loop goes
+    scalar, counting every honest vote exactly once.  The fault shows
+    up in crypto_device_faults, never as dropped votes."""
+    from tendermint_tpu.crypto.backend import PythonBackend
+    from tendermint_tpu.crypto.supervised import SupervisedBackend
+    from tendermint_tpu.utils.chaos import DeviceFault
+    from tendermint_tpu.utils.metrics import REGISTRY
+
+    class DeadFloor:
+        def verify_batch(self, *a):
+            raise DeviceFault("floor offline")
+
+        def verify_grouped(self, *a):
+            raise DeviceFault("floor offline")
+
+    # TM_CHAOS_CRYPTO is the node-operator chaos knob: every device-rung
+    # call raises, and the floor itself is dead -> ladder exhausted
+    monkeypatch.setenv("TM_CHAOS_CRYPTO", "raise:every=1")
+    sup = SupervisedBackend([("dev", PythonBackend()),
+                             ("floor", DeadFloor())],
+                            retries=0, breaker_threshold=100,
+                            call_timeout_s=10.0)
+    monkeypatch.setattr(cb, "_current", sup)
+
+    cs, bid, run, n_vals = _vote_burst()
+    cs._microbatch_threshold = lambda: cs.VOTE_MICROBATCH_MIN
+    faults0 = REGISTRY.crypto_device_faults.value
+    batches0 = REGISTRY.vote_microbatches.value
+    cs._handle_vote_run(run)
+
+    # the storm was SEEN, and the batch path reported no batch
+    assert REGISTRY.crypto_device_faults.value > faults0
+    assert REGISTRY.vote_microbatches.value == batches0
+    # every round-0 precommit accounted exactly once; majority formed
+    pc = cs.votes.precommits(0)
+    assert all(pc._votes[i] is not None for i in range(n_vals))
+    maj = pc.two_thirds_majority()
+    assert maj is not None and maj.hash == bid.hash
+    # the round-boundary stragglers (round 1) also landed via scalar
+    assert sum(v is not None
+               for v in cs.votes.prevotes(1)._votes) == 2
+
+
+def test_vote_burst_device_fault_recovers_down_ladder(monkeypatch):
+    """Same storm, but the ladder has a working floor: the grouped
+    pre-verify survives by falling down the ladder — the batch path
+    stays on, the faults are counted, and the votes land once."""
+    from tendermint_tpu.crypto.backend import PythonBackend
+    from tendermint_tpu.crypto.supervised import SupervisedBackend
+    from tendermint_tpu.utils.metrics import REGISTRY
+
+    monkeypatch.setenv("TM_CHAOS_CRYPTO", "raise:every=1")
+    sup = SupervisedBackend([("dev", PythonBackend()),
+                             ("python", PythonBackend())],
+                            retries=0, breaker_threshold=100,
+                            call_timeout_s=10.0)
+    monkeypatch.setattr(cb, "_current", sup)
+
+    cs, bid, run, n_vals = _vote_burst()
+    cs._microbatch_threshold = lambda: cs.VOTE_MICROBATCH_MIN
+    faults0 = REGISTRY.crypto_device_faults.value
+    cs._handle_vote_run(run)
+
+    assert REGISTRY.crypto_device_faults.value > faults0
+    pc = cs.votes.precommits(0)
+    assert all(pc._votes[i] is not None for i in range(n_vals))
+    assert pc.two_thirds_majority() is not None

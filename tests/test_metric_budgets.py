@@ -1,0 +1,227 @@
+"""Metric-level budget contract (tier-1).
+
+A scenario declares budgets ({metric: {"max"/"min": bound}}) alongside
+its wall-clock budget; the engine grades the body's reported
+`budget_metrics` against them as first-class invariants:
+
+- a value over max / under min is a budget breach (nonzero exit, triage
+  bundle dumped)
+- a metric the body FAILED TO REPORT is itself a breach — a budget that
+  silently stopped being measured must never read as green
+- every swept seed lands in the chaos ledger as its own
+  tpu-bft-chaos-run/1 entry carrying per-metric verdicts, so a budget
+  regression bisects to the exact scenario+seed
+- `cli chaos nightly` wires all of that into the soak gate
+"""
+
+import json
+import os
+
+import pytest
+
+from tendermint_tpu.scenarios import (CHAOS_RUN_SCHEMA, SCENARIOS,
+                                      register, run_scenario, run_sweep)
+
+pytestmark = pytest.mark.faults
+
+_INV = [("noop", lambda ctx, obs: None)]
+
+
+def _toy(name, body, budgets):
+    """Register a throwaway budgeted scenario; caller must pop it."""
+    register(name, "toy budget fixture", safety=_INV, liveness=_INV,
+             smoke=True, budgets=budgets)(body)
+
+
+def test_budget_pass_records_verdicts():
+    _toy("_bgt-pass",
+         lambda ctx: {"budget_metrics": {"lat_p99": 1.5, "rate": 9.0}},
+         budgets={"lat_p99": {"max": 2.0}, "rate": {"min": 5.0}})
+    try:
+        r = run_scenario("_bgt-pass", seed=1)
+    finally:
+        SCENARIOS.pop("_bgt-pass", None)
+    assert r.ok, r.failures
+    assert r.budget_breaches == []
+    assert r.budget_metrics["lat_p99"] == {
+        "max": 2.0, "value": 1.5, "ok": True}
+    assert r.budget_metrics["rate"]["ok"] is True
+
+
+def test_budget_max_breach_fails_the_run():
+    _toy("_bgt-over", lambda ctx: {"budget_metrics": {"lat_p99": 7.25}},
+         budgets={"lat_p99": {"max": 2.0}})
+    try:
+        r = run_scenario("_bgt-over", seed=1)
+    finally:
+        SCENARIOS.pop("_bgt-over", None)
+    assert any("lat_p99=7.25 over declared max 2" in b
+               for b in r.budget_breaches), r.budget_breaches
+    assert r.budget_metrics["lat_p99"]["ok"] is False
+
+
+def test_budget_min_breach_fails_the_run():
+    _toy("_bgt-under", lambda ctx: {"budget_metrics": {"rate": 0.5}},
+         budgets={"rate": {"min": 5.0}})
+    try:
+        r = run_scenario("_bgt-under", seed=1)
+    finally:
+        SCENARIOS.pop("_bgt-under", None)
+    assert any("rate=0.5 under declared min 5" in b
+               for b in r.budget_breaches), r.budget_breaches
+
+
+def test_missing_budget_metric_is_a_breach():
+    """The sampler died / the body stopped reporting: the budget must
+    not silently read as green."""
+    _toy("_bgt-missing", lambda ctx: {"budget_metrics": {}},
+         budgets={"lat_p99": {"max": 2.0}})
+    try:
+        r = run_scenario("_bgt-missing", seed=1)
+    finally:
+        SCENARIOS.pop("_bgt-missing", None)
+    assert any("missing" in b for b in r.budget_breaches), \
+        r.budget_breaches
+    assert r.budget_metrics["lat_p99"] == {
+        "max": 2.0, "value": None, "ok": False}
+
+
+def test_budget_metric_falls_back_to_top_level_obs():
+    """obs['budget_metrics'] is preferred but a top-level obs key of
+    the same name also satisfies the budget."""
+    _toy("_bgt-toplvl", lambda ctx: {"lat_p99": 1.0},
+         budgets={"lat_p99": {"max": 2.0}})
+    try:
+        r = run_scenario("_bgt-toplvl", seed=1)
+    finally:
+        SCENARIOS.pop("_bgt-toplvl", None)
+    assert r.budget_breaches == []
+    assert r.budget_metrics["lat_p99"]["value"] == 1.0
+
+
+def test_budget_declaration_validation():
+    bad = [("nan-spec", {"m": "fast"}), ("bad-key", {"m": {"p99": 1}}),
+           ("empty-spec", {"m": {}})]
+    for name, budgets in bad:
+        with pytest.raises(ValueError, match="budget"):
+            register(f"_bgt-{name}", "d", safety=_INV, liveness=_INV,
+                     budgets=budgets)(lambda ctx: {})
+        assert f"_bgt-{name}" not in SCENARIOS
+    # a bare number is shorthand for max
+    _toy("_bgt-bare", lambda ctx: {"budget_metrics": {"m": 1.0}},
+         budgets={"m": 3})
+    try:
+        assert SCENARIOS["_bgt-bare"].budgets == {"m": {"max": 3.0}}
+    finally:
+        SCENARIOS.pop("_bgt-bare", None)
+
+
+def test_budget_breach_dumps_triage_bundle(tmp_path):
+    """A metric breach is triageable without a re-run: the artifact
+    bundle is dumped even though every invariant held, and result.json
+    carries the breach strings + per-metric verdicts."""
+    _toy("_bgt-bundle", lambda ctx: {"budget_metrics": {"lat_p99": 9.0}},
+         budgets={"lat_p99": {"max": 2.0}})
+    try:
+        r = run_scenario("_bgt-bundle", seed=1, artifacts=str(tmp_path))
+    finally:
+        SCENARIOS.pop("_bgt-bundle", None)
+    assert r.ok                      # invariants held...
+    assert r.budget_breaches        # ...but the budget did not
+    assert r.artifact_dir and os.path.exists(r.artifact_dir)
+    with open(os.path.join(r.artifact_dir, "result.json")) as f:
+        manifest = json.load(f)
+    assert manifest["budget_breaches"] == r.budget_breaches
+    assert manifest["budget_metrics"]["lat_p99"]["ok"] is False
+
+
+def test_sweep_ledgers_per_seed_verdicts(tmp_path):
+    """Every swept seed writes its own chaos-run entry with the
+    per-metric verdicts — the nightly's bisectable record."""
+    from tendermint_tpu.utils import ledger as ledgermod
+    calls = []
+
+    def body(ctx):
+        calls.append(ctx.seed)
+        # seed 1 breaches, the others pass
+        return {"budget_metrics": {"lat_p99": 5.0 if ctx.seed == 1
+                                   else 1.0}}
+
+    _toy("_bgt-sweep", body, budgets={"lat_p99": {"max": 2.0}})
+    ledger_path = str(tmp_path / "ledger.jsonl")
+    try:
+        out = run_sweep(["_bgt-sweep"], [0, 1, 2],
+                        artifacts=str(tmp_path), ledger_path=ledger_path)
+    finally:
+        SCENARIOS.pop("_bgt-sweep", None)
+    assert sorted(calls) == [0, 1, 2]
+    assert out["summary"]["total_breaches"] == 1
+    runs = {e["seed"]: e for e in ledgermod.load(ledger_path)
+            if e.get("schema") == CHAOS_RUN_SCHEMA}
+    assert sorted(runs) == [0, 1, 2]
+    assert runs[1]["budget_breaches"] and not runs[0]["budget_breaches"]
+    assert runs[1]["budget_metrics"]["lat_p99"]["ok"] is False
+    assert runs[0]["budget_metrics"]["lat_p99"] == {
+        "max": 2.0, "value": 1.0, "ok": True}
+
+
+# -- cli chaos nightly ------------------------------------------------------
+
+def test_cli_chaos_nightly_green_path(tmp_path, capsys):
+    """The gate on a passing catalogue subset: per-seed run entries +
+    one aggregate row land in the ledger, exit code 0."""
+    from tendermint_tpu.cli import main
+    from tendermint_tpu.utils import ledger as ledgermod
+    ledger_path = str(tmp_path / "ledger.jsonl")
+    rc = main(["chaos", "nightly",
+               "--scenarios", "device-wrong-answer,byz-equivocation",
+               "--seed-range", "0:2", "--budget-ledger", ledger_path,
+               "--artifacts", str(tmp_path / "arts")])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "chaos nightly seeds 0:2: 4/4 passed" in out
+    entries = ledgermod.load(ledger_path)
+    runs = [e for e in entries if e.get("schema") == CHAOS_RUN_SCHEMA]
+    assert len(runs) == 4
+    assert any(e.get("nightly") for e in entries)
+
+
+def test_cli_chaos_nightly_exits_nonzero_on_breach(tmp_path, capsys):
+    """A metric breach anywhere in the sweep: nonzero exit and the
+    triage bundle path printed."""
+    from tendermint_tpu.cli import main
+    _toy("_bgt-red", lambda ctx: {"budget_metrics": {"lat_p99": 9.0}},
+         budgets={"lat_p99": {"max": 2.0}})
+    try:
+        rc = main(["chaos", "nightly", "--scenarios", "_bgt-red",
+                   "--seed-range", "0:2",
+                   "--budget-ledger", str(tmp_path / "ledger.jsonl"),
+                   "--artifacts", str(tmp_path / "arts")])
+    finally:
+        SCENARIOS.pop("_bgt-red", None)
+    out = capsys.readouterr().out
+    assert rc == 1, out
+    assert "triage: " in out
+    assert "2 over budget" in out
+
+
+def test_cli_chaos_nightly_skips_are_loud(tmp_path, capsys):
+    """A near-zero global budget: the first scenario spends it, the
+    rest are SKIPPED and SAY so — budget pressure must never silently
+    shrink the catalogue."""
+    from tendermint_tpu.cli import main
+    rc = main(["chaos", "nightly",
+               "--scenarios", "device-wrong-answer,byz-equivocation",
+               "--seed-range", "0:2", "--budget", "0.01",
+               "--budget-ledger", "",
+               "--artifacts", str(tmp_path / "arts")])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "PASS device-wrong-answer" in out
+    assert "SKIP byz-equivocation x2 seeds" in out
+    assert "1 scenarios skipped" in out
+
+
+def test_cli_chaos_nightly_rejects_unknown_scenario(capsys):
+    from tendermint_tpu.cli import main
+    assert main(["chaos", "nightly", "--scenarios", "no-such-rig"]) == 2

@@ -250,6 +250,10 @@ def test_debug_doctor_and_bench_history_routes(node, client, tmp_path,
         r = Routes(node)
         assert "debug_doctor" in r.table
         assert "debug_bench_history" in r.table
+        # the recorder ring is process-global: window-keyed spans left
+        # by earlier fast-sync tests would flip the doctor into
+        # window attribution and hide the span injected below
+        tracing.RECORDER.clear()
         tracing.RECORDER.record("scalar.verify", ts_s=2000.0, dur_s=1.0)
         rep = r.debug_doctor({})["report"]
         assert rep["schema"] == "tpu-bft-doctor/1"

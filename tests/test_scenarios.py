@@ -137,10 +137,16 @@ def test_seed_range_sweep_of_a_smoke_scenario(tmp_path):
     assert cfg["runs_per_sec"] > 0
     assert len(out["results"]) == 3
     entries = ledgermod.load(ledger_path)
-    assert len(entries) == 1
+    # one per-seed run entry each, plus the aggregate rates row
+    from tendermint_tpu.scenarios import CHAOS_RUN_SCHEMA
+    runs = [e for e in entries if e.get("schema") == CHAOS_RUN_SCHEMA]
+    aggs = [e for e in entries if e.get("schema") != CHAOS_RUN_SCHEMA]
+    assert sorted(e["seed"] for e in runs) == seeds
+    assert all(e["scenario"] == "device-wrong-answer" for e in runs)
+    assert len(aggs) == 1
     rate, unit = ledgermod.rate_of(
         "device-wrong-answer",
-        entries[0]["configs"]["device-wrong-answer"])
+        aggs[0]["configs"]["device-wrong-answer"])
     assert rate and rate > 0 and unit == "runs_per_sec"
 
 

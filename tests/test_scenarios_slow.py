@@ -19,9 +19,23 @@ STRESS = sorted(n for n, sc in SCENARIOS.items() if not sc.smoke)
 
 def test_stress_catalog_is_what_we_think():
     assert STRESS == ["crash-restart-storm", "device-storm-partition",
-                      "equivocation-crash-restart", "partial-commit-replay",
+                      "equivocation-crash-restart",
+                      "live-rounds-100-chaos", "live-rounds-50",
+                      "partial-commit-replay",
                       "partition-heal", "partition-heal-25",
                       "stale-commit-replay", "stale-replay-partition"]
+
+
+def test_every_stress_scenario_declares_metric_budgets():
+    """The scenario-budget tmlint rule's runtime twin: a stress rig
+    without a budgeted metric only fails on outright invariant
+    violations, so a fault-path latency regression reads as green."""
+    for name in STRESS:
+        sc = SCENARIOS[name]
+        assert sc.budgets, f"{name} declares no metric budgets"
+        for metric, spec in sc.budgets.items():
+            assert set(spec) & {"min", "max"}, \
+                f"{name} budget {metric} has neither min nor max"
 
 
 @pytest.mark.parametrize("name", STRESS)

@@ -378,10 +378,65 @@ def test_bench_scalar_loop_quiet_on_vectorized_and_other_spans(tmp_path):
     assert [f for f in findings if f.rule == "bench-scalar-loop"] == []
 
 
+def test_scenario_budget_flags_stress_without_budgets(tmp_path):
+    findings = lint_src(tmp_path, """
+        from tendermint_tpu.scenarios.engine import register
+
+        def _safety(ctx, obs):
+            pass
+
+        # stress tier (smoke absent) with no budgets kwarg at all
+        @register("storm-a", "a storm", safety=[("s", _safety)],
+                  liveness=[("l", _safety)], budget_s=60.0)
+        def storm_a(ctx):
+            return {}
+
+        # explicit smoke=False with an EMPTY budgets dict
+        @register("storm-b", "b storm", safety=[("s", _safety)],
+                  liveness=[("l", _safety)], smoke=False, budgets={})
+        def storm_b(ctx):
+            return {}
+        """)
+    hits = [f for f in findings if f.rule == "scenario-budget"]
+    assert len(hits) == 2, findings
+    assert "storm-a" in hits[0].message
+    assert "storm-b" in hits[1].message
+
+
+def test_scenario_budget_quiet_on_smoke_and_budgeted(tmp_path):
+    findings = lint_src(tmp_path, """
+        from tendermint_tpu.scenarios.engine import register
+
+        def _safety(ctx, obs):
+            pass
+
+        # smoke tier: budgets optional
+        @register("quick", "a smoke", safety=[("s", _safety)],
+                  liveness=[("l", _safety)], smoke=True)
+        def quick(ctx):
+            return {}
+
+        # stress tier WITH a declared budget: compliant
+        @register("storm", "a storm", safety=[("s", _safety)],
+                  liveness=[("l", _safety)], smoke=False,
+                  budgets={"commit_latency_p99": {"max": 30.0}})
+        def storm(ctx):
+            return {}
+
+        # an unrelated register() (e.g. the rule registry) is ignored
+        def register_other(cls):
+            return cls
+
+        table = register_other(dict)
+        """)
+    assert [f for f in findings if f.rule == "scenario-budget"] == []
+
+
 def test_rule_catalog_covers_all_families():
     from tendermint_tpu.analysis import all_rules
     names = {n for n, _ in all_rules()}
     assert {"lock-order", "unlocked-write", "jax-host-sync",
             "jax-retrace", "jax-static-argnums", "route-gating",
             "route-write-containment", "span-category",
-            "bench-scalar-loop", "metric-name"} <= names
+            "bench-scalar-loop", "metric-name",
+            "scenario-budget"} <= names
