@@ -44,6 +44,26 @@ class Application:
         """Returns the new app hash in `data`."""
         return Result(OK)
 
+    # -- state sync (statesync/ snapshot plane) -------------------------
+    # Modeled on ABCI's ListSnapshots/ApplySnapshotChunk pair, collapsed
+    # to one blob: the statesync layer owns chunking and verification,
+    # the app only (de)serializes its full state.  Apps that don't
+    # override these are not snapshottable (`supports_snapshots()` is
+    # how callers gate snapshot creation).
+
+    def snapshot_state(self) -> bytes:
+        """Serialize the full app state at the current committed height."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support state snapshots")
+
+    def restore_state(self, data: bytes) -> None:
+        """Replace the app state with a previously serialized snapshot."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support state snapshots")
+
+    def supports_snapshots(self) -> bool:
+        return type(self).snapshot_state is not Application.snapshot_state
+
 
 _REGISTRY: dict[str, type] = {}
 

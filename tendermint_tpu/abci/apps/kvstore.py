@@ -39,8 +39,11 @@ class KVStoreApp(Application):
     def _set(self, k: bytes, v: bytes) -> None:
         b = hashlib.sha256(k).digest()[0]
         self.state[k] = v
+        self._buckets[b][k] = v
+        self._rehash_bucket(b)
+
+    def _rehash_bucket(self, b: int) -> None:
         bucket = self._buckets[b]
-        bucket[k] = v
         h = hashlib.sha256()
         for bk in sorted(bucket):
             bv = bucket[bk]
@@ -87,6 +90,46 @@ class KVStoreApp(Application):
         return ResponseQuery(code=OK, key=data, value=v, log="exists",
                              height=self.height)
 
+    # -- state sync -----------------------------------------------------
+    def snapshot_state(self) -> bytes:
+        """Full state as u64(height) || (lp(k) || lp(v))* sorted by key —
+        deterministic, so two nodes at the same height serialize the
+        identical blob (and the identical snapshot chunk hashes)."""
+        out = [self.height.to_bytes(8, "big")]
+        for k in sorted(self.state):
+            v = self.state[k]
+            out.append(len(k).to_bytes(4, "big") + k)
+            out.append(len(v).to_bytes(4, "big") + v)
+        return b"".join(out)
+
+    def restore_state(self, data: bytes) -> None:
+        """Rebuild from a snapshot blob.  Buckets are filled first and
+        digested ONCE each: restoring through `_set` would re-hash each
+        growing bucket per key — O(state²/256), i.e. as slow as replaying
+        every tx, which defeats the point of a snapshot."""
+        height = int.from_bytes(data[:8], "big")
+        off, n = 8, len(data)
+        state: dict[bytes, bytes] = {}
+        while off < n:
+            klen = int.from_bytes(data[off:off + 4], "big")
+            k = data[off + 4:off + 4 + klen]
+            off += 4 + klen
+            vlen = int.from_bytes(data[off:off + 4], "big")
+            v = data[off + 4:off + 4 + vlen]
+            off += 4 + vlen
+            if len(k) != klen or len(v) != vlen:
+                raise ValueError("truncated kvstore snapshot blob")
+            state[k] = v
+        self.state = state
+        self.height = height
+        self._buckets = [{} for _ in range(N_BUCKETS)]
+        self._bucket_digest = [bytes(32)] * N_BUCKETS
+        for k, v in state.items():
+            self._buckets[hashlib.sha256(k).digest()[0]][k] = v
+        for b in range(N_BUCKETS):
+            if self._buckets[b]:
+                self._rehash_bucket(b)
+
 
 class PersistentKVStoreApp(KVStoreApp):
     """Disk-backed variant (reference `persistent_dummy`): used by crash
@@ -103,11 +146,25 @@ class PersistentKVStoreApp(KVStoreApp):
             with open(self.db_path) as f:
                 d = json.load(f)
             self.height = d["height"]
+            # bucket-first load, one digest pass per bucket (same
+            # reasoning as restore_state: per-key _set is quadratic)
             for k, v in d["state"].items():
-                self._set(bytes.fromhex(k), bytes.fromhex(v))
+                kb, vb = bytes.fromhex(k), bytes.fromhex(v)
+                self.state[kb] = vb
+                self._buckets[hashlib.sha256(kb).digest()[0]][kb] = vb
+            for b in range(N_BUCKETS):
+                if self._buckets[b]:
+                    self._rehash_bucket(b)
 
     def commit(self) -> Result:
         res = super().commit()
+        self.persist_state()
+        return res
+
+    def persist_state(self) -> None:
+        """Write the current state to disk (tmp + fsync + rename).
+        Commit's persistence step, also called directly after a
+        snapshot restore_state (which bypasses commit)."""
         tmp = self.db_path + ".tmp"
         with open(tmp, "w") as f:
             json.dump({"height": self.height,
@@ -116,7 +173,6 @@ class PersistentKVStoreApp(KVStoreApp):
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self.db_path)
-        return res
 
 
 register_app("kvstore", KVStoreApp)

@@ -91,7 +91,12 @@ class BlockchainReactor(Reactor):
         self.store = block_store
         self.fast_sync = fast_sync
         self.batch_size = batch_size
-        self.pool = BlockPool(block_store.height + 1)
+        # a snapshot-restored node's state can be AHEAD of its (pruned /
+        # freshly bootstrapped) block store — sync from whichever cursor
+        # is further along, never re-request blocks the state already
+        # executed
+        self.pool = BlockPool(
+            max(block_store.height, state.last_block_height) + 1)
         self.pool.on_evict = self._on_pool_evict
         self.on_caught_up = None          # cb(state) -> switch_to_consensus
         self._stopped = threading.Event()

@@ -36,11 +36,19 @@ class BlockStore:
         self.db = db
         raw = db.get(b"blockStore:height")
         self._height = int.from_bytes(raw, "big") if raw else 0
+        raw = db.get(b"blockStore:base")
+        self._base = int.from_bytes(raw, "big") if raw else 1
 
     @property
     def height(self) -> int:
         """Height of the highest stored block."""
         return self._height
+
+    @property
+    def base(self) -> int:
+        """Lowest stored height; heights below have been pruned (or were
+        never stored — a snapshot-restored node starts above genesis)."""
+        return self._base
 
     # -- save -----------------------------------------------------------
     def save_block(self, block: Block, part_set: PartSet,
@@ -65,6 +73,50 @@ class BlockStore:
         self.db.set_batch(kvs)
         self._height = h
 
+    # -- prune / bootstrap ----------------------------------------------
+    def prune(self, retain_height: int) -> int:
+        """Drop all blocks below `retain_height` (reference
+        `store.PruneBlocks` semantics): after pruning, `base` is
+        `retain_height` and `load_block` below it returns None — the
+        fast-sync reactor then answers NoBlockResponse, a polite refusal
+        instead of a crash.  Returns the number of blocks pruned.
+        Snapshots make pruning safe: a peer that needs the pruned prefix
+        restores from a snapshot at >= retain_height instead."""
+        if retain_height <= self._base:
+            return 0
+        if retain_height > self._height + 1:
+            raise ValueError(
+                f"cannot retain from {retain_height}: store height is "
+                f"{self._height}")
+        pruned = 0
+        for h in range(self._base, retain_height):
+            meta = self.load_block_meta(h)
+            if meta is not None:
+                for i in range(meta.block_id.parts.total):
+                    self.db.delete(b"P:%d:%d" % (h, i))
+                pruned += 1
+            self.db.delete(b"H:%d" % h)
+            self.db.delete(b"C:%d" % h)
+            self.db.delete(b"SC:%d" % h)
+        self._base = retain_height
+        self.db.set(b"blockStore:base", retain_height.to_bytes(8, "big"))
+        return pruned
+
+    def bootstrap(self, height: int) -> None:
+        """Prime an EMPTY store at a snapshot height: the store holds no
+        blocks yet, but save_block must accept `height + 1` next and
+        requests at or below `height` must refuse politely, so both
+        cursors move to the snapshot (base = height + 1: not even the
+        snapshot's own block is stored)."""
+        if self._height != 0:
+            raise ValueError(
+                f"bootstrap on a non-empty store (height {self._height})")
+        self._height = height
+        self._base = height + 1
+        self.db.set_batch([
+            (b"blockStore:height", height.to_bytes(8, "big")),
+            (b"blockStore:base", (height + 1).to_bytes(8, "big"))])
+
     # -- load -----------------------------------------------------------
     def load_block_meta(self, height: int) -> BlockMeta | None:
         raw = self.db.get(b"H:%d" % height)
@@ -75,7 +127,12 @@ class BlockStore:
         return Part.decode(Reader(raw)) if raw else None
 
     def load_block(self, height: int) -> Block | None:
-        """Reassemble from parts (reference `blockchain/store.go:60-81`)."""
+        """Reassemble from parts (reference `blockchain/store.go:60-81`).
+        Heights below `base` return None even if a crash mid-prune left a
+        stale meta behind — missing parts below base are pruned, not
+        corrupt."""
+        if height < self._base:
+            return None
         meta = self.load_block_meta(height)
         if meta is None:
             return None

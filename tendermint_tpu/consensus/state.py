@@ -146,6 +146,15 @@ class ConsensusState:
             return
         seen = self.block_store.load_seen_commit(state.last_block_height)
         if seen is None:
+            if state.last_block_height < getattr(self.block_store,
+                                                 "base", 1):
+                # snapshot-restored (or pruned) node: block H was never
+                # stored here, so no SeenCommit exists.  The +2/3 for H
+                # rides in block H+1's last_commit, which fast-sync is
+                # about to fetch; until switch_to_consensus re-runs this
+                # the node simply cannot propose — correct for a
+                # catching-up node.
+                return
             raise RuntimeError(
                 f"no seen commit for height {state.last_block_height}")
         vset = VoteSet(state.chain_id, state.last_block_height, seen.round(),

@@ -1336,4 +1336,5 @@ register(
 
 
 SMOKE_ORDER = ["device-wrong-answer", "evidence-flood",
-               "byz-equivocation", "device-rung-walk"]
+               "byz-equivocation", "device-rung-walk",
+               "snapshot-torn-tail"]

@@ -152,6 +152,11 @@ class WireMesh:
         for i in range(n):
             self.nodes[i].cs.broadcast_cb = self._make_cb(i)
         self.restarts = 0
+        # one report per restart(): {"node", "replay_blocks", "replay_s"}
+        # — scenario notes cite these instead of re-deriving them, and
+        # the snapshot-join budget compares them against restore+tail
+        self.restart_reports: list[dict] = []
+        self._last_replay = (0, 0.0)
         self._samples: list[tuple[int, float]] = []   # (height, t_seen)
         self._sampler: threading.Thread | None = None
         self._sampler_stop = threading.Event()
@@ -165,13 +170,17 @@ class WireMesh:
         store = BlockStore(self.store_dbs[i])
         st = get_state(MemDB(), self.gen)
         conns = ClientCreator(self.app).new_app_conns()
-        for h in range(1, store.height + 1):
+        t0 = time.time()
+        replayed = 0
+        for h in range(store.base, store.height + 1):
             block = store.load_block(h)
             meta = store.load_block_meta(h)
             execution.apply_block(st, None, conns.consensus, block,
                                   meta.block_id.parts,
                                   execution.MockMempool(),
                                   check_last_commit=False)
+            replayed += 1
+        self._last_replay = (replayed, time.time() - t0)
         return WireNode(self.privs[i], self.gen,
                         cfg=config_with_timeouts(self._timeouts),
                         app=self.app, state=st, conns=conns,
@@ -227,6 +236,10 @@ class WireMesh:
             self._down.discard(i)
         node.cs.start()
         self.restarts += 1
+        replayed, dt = self._last_replay
+        self.restart_reports.append({"node": i,
+                                     "replay_blocks": replayed,
+                                     "replay_s": round(dt, 4)})
 
     # -- partitions -----------------------------------------------------
 
@@ -295,13 +308,15 @@ class WireMesh:
 # -- fast-sync rig ----------------------------------------------------------
 
 def fastsync_source(chain_id: str, chain, gen, moniker: str = "source",
-                    config=None):
+                    config=None, app="kvstore"):
     """A served chain: store + state advanced to the tip, behind a
     switch.  Returns (switch, state, store).  Pass a P2PConfig with a
     TCP `laddr` to make the source dialable (the rig for persistent-
-    peer reconnect scenarios)."""
+    peer reconnect scenarios).  Pass an Application instance as `app`
+    to keep a handle on the served app — the snapshot rigs do, so the
+    source can also serve snapshots of its state."""
     state = get_state(MemDB(), gen)
-    conns = ClientCreator("kvstore").new_app_conns()
+    conns = ClientCreator(app).new_app_conns()
     store = BlockStore(MemDB())
     for block, ps, seen in chain:
         store.save_block(block, ps, seen)
@@ -316,14 +331,20 @@ def fastsync_source(chain_id: str, chain, gen, moniker: str = "source",
 
 
 def fastsync_syncer(chain_id: str, gen, batch_size: int = 8,
-                    fuzz: bool = False):
+                    fuzz: bool = False, state=None, store=None,
+                    app="kvstore"):
     """A fresh syncing node.  Returns (switch, bc_reactor, cons_reactor,
     store).  With `fuzz=True` every link gets an inert FuzzedConnection
     wrapper (zero probabilities) so partition injectors can sever
-    individual source links mid-sync."""
-    state = get_state(MemDB(), gen)
-    conns = ClientCreator("kvstore").new_app_conns()
-    store = BlockStore(MemDB())
+    individual source links mid-sync.
+
+    `state`/`store`/`app` are injectable for the snapshot-join rig: a
+    snapshot-restored State + a `bootstrap()`ed store + the restored
+    Application instance make this node sync only the short tail
+    `snapshot_height -> tip` instead of the whole chain."""
+    state = state if state is not None else get_state(MemDB(), gen)
+    conns = ClientCreator(app).new_app_conns()
+    store = store if store is not None else BlockStore(MemDB())
     mp = Mempool(conns.mempool)
     cs = ConsensusState(test_config().consensus, state.copy(),
                         conns.consensus, store, mp)

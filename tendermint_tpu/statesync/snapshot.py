@@ -1,0 +1,415 @@
+"""Snapshot creation + on-disk format.
+
+Layout under a snapshot root directory:
+
+    snapshots/
+      snapshot-0000000500/
+        chunk-000000.bin
+        chunk-000001.bin
+        ...
+        manifest.json        # written LAST, via tmp + atomic rename
+
+The chunked payload is `lp(state_bytes) || lp(app_state_bytes)` split
+into fixed-size chunks; the manifest commits to every chunk hash
+(0x00-domain-separated SHA-256 leaf hashes, same tree as every other
+Merkle structure here) and their root.  Failure semantics mirror the
+consensus WAL's CRC framing philosophy:
+
+- the manifest is written last and carries a crc32 of its canonical
+  body, so a crash at ANY point of snapshot creation leaves either a
+  chunk directory with no (or a torn) manifest — discarded on scan —
+  or a complete, verifiable snapshot;
+- a manifest whose listed chunk hashes don't re-root to its `root`
+  field is discarded (a lying or bit-rotted manifest never offers);
+- chunk files are re-hashed against the manifest on `verify()` (the
+  `cli snapshot verify` path) and at restore time, so disk corruption
+  after a clean write is caught before any byte reaches the app.
+
+Chunk hashing runs through the device Merkle kernels
+(`ops/merkle.leaf_hashes_jit`) when the uniform chunk shapes allow AND
+the installed crypto backend actually runs the TPU rung (on a CPU-only
+rig the XLA compile of a multi-KB-row SHA-256 batch costs minutes, so
+those rigs keep the host loop; `TM_SNAPSHOT_DEVICE_HASH` forces either
+way).  The host tree (`types/merkle`) is the differential-tested
+fallback — snapshot verification is the same TPU hot path the block
+pipeline uses.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import zlib
+from dataclasses import dataclass
+
+from tendermint_tpu.types import merkle as hmerkle
+from tendermint_tpu.utils.fail import fail_point
+from tendermint_tpu.utils.metrics import REGISTRY
+from tendermint_tpu.utils import log as log_mod
+
+log = log_mod.get_logger("statesync")
+
+SNAPSHOT_SCHEMA = "tpu-bft-snapshot/1"
+SNAPSHOT_FORMAT = 1
+DEFAULT_CHUNK_SIZE = 64 * 1024
+DEFAULT_RETAIN = 2
+MANIFEST_NAME = "manifest.json"
+
+# below this many uniform chunks the jit dispatch costs more than the
+# host loop; the differential tests pin both paths to identical hashes
+_DEVICE_MIN_CHUNKS = 8
+
+
+def _device_hash_enabled() -> bool:
+    """Whether chunk hashing may take the jitted device kernel.  Follows
+    the ambient crypto rung: on a CPU-only rig (python/native backends,
+    every scenario run, this repo's CI) the XLA compile of a
+    multi-KB-row SHA-256 batch costs minutes — far more than the host
+    loop ever will — so the device path is reserved for rigs that
+    actually run the TPU rung.  `TM_SNAPSHOT_DEVICE_HASH=1/0` forces
+    either way."""
+    forced = os.environ.get("TM_SNAPSHOT_DEVICE_HASH")
+    if forced is not None:
+        return forced not in ("0", "false", "no")
+    from tendermint_tpu.crypto import backend as cb
+    cur = getattr(cb, "_current", None)   # peek; don't install one
+    if cur is None:
+        return False
+    if getattr(cur, "name", "") == "tpu":
+        return True
+    rungs = getattr(cur, "_rungs", None)  # supervised ladder: top rung
+    return bool(rungs) and getattr(rungs[0], "name", "") == "tpu"
+
+
+# -- payload ----------------------------------------------------------------
+
+def encode_payload(state_bytes: bytes, app_state: bytes) -> bytes:
+    """`lp(state) || lp(app_state)` — one blob the chunker splits."""
+    return (len(state_bytes).to_bytes(4, "big") + state_bytes +
+            len(app_state).to_bytes(4, "big") + app_state)
+
+
+def decode_payload(payload: bytes) -> tuple[bytes, bytes]:
+    if len(payload) < 4:
+        raise ValueError("snapshot payload truncated (no state length)")
+    n = int.from_bytes(payload[:4], "big")
+    state_bytes = payload[4:4 + n]
+    if len(state_bytes) != n:
+        raise ValueError("snapshot payload truncated (state)")
+    rest = payload[4 + n:]
+    if len(rest) < 4:
+        raise ValueError("snapshot payload truncated (no app length)")
+    m = int.from_bytes(rest[:4], "big")
+    app_state = rest[4:4 + m]
+    if len(app_state) != m or len(rest) != 4 + m:
+        raise ValueError("snapshot payload truncated (app state)")
+    return state_bytes, app_state
+
+
+def split_chunks(payload: bytes, chunk_size: int) -> list[bytes]:
+    if chunk_size <= 0:
+        raise ValueError(f"chunk_size must be positive, got {chunk_size}")
+    if not payload:
+        return [b""]
+    return [payload[i:i + chunk_size]
+            for i in range(0, len(payload), chunk_size)]
+
+
+def hash_chunks(chunks: list[bytes]) -> list[bytes]:
+    """Leaf hash per chunk.  The uniform-length prefix (every chunk but
+    a possibly-short tail) goes through the batched device kernel in one
+    lockstep SHA-256; the tail and any small batch hash host-side."""
+    if not chunks:
+        return []
+    uniform = len(chunks)
+    tail_len = len(chunks[-1])
+    if uniform > 1 and tail_len != len(chunks[0]):
+        uniform -= 1
+    out: list[bytes] | None = None
+    if uniform >= _DEVICE_MIN_CHUNKS and _device_hash_enabled():
+        try:
+            import numpy as np
+            from tendermint_tpu.ops import merkle as dmerkle
+            data = np.frombuffer(b"".join(chunks[:uniform]),
+                                 dtype=np.uint8)
+            data = data.reshape(uniform, len(chunks[0]))
+            hashed = np.asarray(dmerkle.leaf_hashes_jit(data))
+            out = [hashed[i].tobytes() for i in range(uniform)]
+        except Exception:   # no device/jax: host fallback is exact
+            log.exception("device chunk hashing failed; host fallback")
+            out = None
+    if out is None:
+        out = [hmerkle.leaf_hash(c) for c in chunks[:uniform]]
+    out.extend(hmerkle.leaf_hash(c) for c in chunks[uniform:])
+    return out
+
+
+def verify_chunk_hashes(chunks: dict[int, bytes],
+                        expected: tuple[bytes, ...]) -> list[int]:
+    """Indices whose chunk bytes do NOT hash to the manifest's
+    commitment.  One batched call over everything fetched; counts land
+    on the chunks_verified / chunks_rejected metrics."""
+    idxs = sorted(chunks)
+    hashed = hash_chunks([chunks[i] for i in idxs])
+    bad = [i for i, h in zip(idxs, hashed) if h != expected[i]]
+    if len(idxs) - len(bad):
+        REGISTRY.chunks_verified.inc(len(idxs) - len(bad))
+    if bad:
+        REGISTRY.chunks_rejected.inc(len(bad))
+    return bad
+
+
+# -- manifest ---------------------------------------------------------------
+
+@dataclass(frozen=True)
+class SnapshotManifest:
+    height: int
+    format: int
+    chunk_size: int
+    chunk_hashes: tuple[bytes, ...]
+    root: bytes
+    app_hash: bytes
+
+    @property
+    def chunks(self) -> int:
+        return len(self.chunk_hashes)
+
+    def key(self) -> tuple:
+        """Identity for cross-peer offer matching: two peers offering
+        the same (height, format, root, app_hash) offer the same
+        snapshot.  app_hash is part of the identity so a forged
+        manifest that reuses honest chunks (same root) but lies about
+        the app hash forms its OWN offer group — blamed on its own
+        providers, never mixed into the honest group."""
+        return (self.height, self.format, self.root, self.app_hash)
+
+    def canonical_body(self) -> dict:
+        return {
+            "schema": SNAPSHOT_SCHEMA, "height": self.height,
+            "format": self.format, "chunk_size": self.chunk_size,
+            "chunk_hashes": [h.hex() for h in self.chunk_hashes],
+            "root": self.root.hex(), "app_hash": self.app_hash.hex(),
+        }
+
+    def encode_json(self) -> bytes:
+        body = self.canonical_body()
+        raw = json.dumps(body, sort_keys=True,
+                         separators=(",", ":")).encode()
+        body["crc32"] = zlib.crc32(raw)
+        return json.dumps(body, sort_keys=True).encode()
+
+    @classmethod
+    def decode_json(cls, raw: bytes) -> "SnapshotManifest":
+        """Parse + integrity-check a manifest.  Raises ValueError on a
+        torn/garbled file, a CRC mismatch, or chunk hashes that don't
+        re-root to the committed root."""
+        try:
+            d = json.loads(raw)
+        except (json.JSONDecodeError, UnicodeDecodeError) as e:
+            raise ValueError(f"torn manifest: {e}") from None
+        if not isinstance(d, dict) or d.get("schema") != SNAPSHOT_SCHEMA:
+            raise ValueError(f"not a {SNAPSHOT_SCHEMA} manifest")
+        crc = d.pop("crc32", None)
+        canon = json.dumps(d, sort_keys=True,
+                           separators=(",", ":")).encode()
+        if crc != zlib.crc32(canon):
+            raise ValueError("manifest crc32 mismatch (torn write)")
+        m = cls(height=int(d["height"]), format=int(d["format"]),
+                chunk_size=int(d["chunk_size"]),
+                chunk_hashes=tuple(bytes.fromhex(h)
+                                   for h in d["chunk_hashes"]),
+                root=bytes.fromhex(d["root"]),
+                app_hash=bytes.fromhex(d["app_hash"]))
+        if hmerkle.root_from_leaf_hashes(list(m.chunk_hashes)) != m.root:
+            raise ValueError("manifest chunk hashes do not re-root to "
+                             "the committed root")
+        return m
+
+
+# -- store ------------------------------------------------------------------
+
+class SnapshotStore:
+    """Disk-backed snapshot collection with retention.
+
+    `create()` is the only writer; every reader revalidates (manifest
+    CRC + root re-check) so a torn snapshot — crash mid-create, fsck'd
+    disk — is silently unavailable rather than silently wrong."""
+
+    def __init__(self, root_dir: str,
+                 chunk_size: int = DEFAULT_CHUNK_SIZE,
+                 retain: int = DEFAULT_RETAIN):
+        if retain < 1:
+            raise ValueError(f"retain must be >= 1, got {retain}")
+        self.root_dir = root_dir
+        self.chunk_size = chunk_size
+        self.retain = retain
+        os.makedirs(root_dir, exist_ok=True)
+
+    # -- paths ----------------------------------------------------------
+    def snapshot_dir(self, height: int) -> str:
+        return os.path.join(self.root_dir, f"snapshot-{height:010d}")
+
+    @staticmethod
+    def _chunk_path(sdir: str, index: int) -> str:
+        return os.path.join(sdir, f"chunk-{index:06d}.bin")
+
+    # -- create ---------------------------------------------------------
+    def create(self, state, app_state: bytes) -> SnapshotManifest:
+        """Snapshot `state` (a state.State at its committed height) +
+        the serialized app state.  Chunks land first, the manifest last
+        via tmp + atomic rename; then retention prunes old heights."""
+        t0 = time.time()
+        height = state.last_block_height
+        if height <= 0:
+            raise ValueError("cannot snapshot at height 0")
+        payload = encode_payload(state.encode(), app_state)
+        chunks = split_chunks(payload, self.chunk_size)
+        hashes = hash_chunks(chunks)
+        manifest = SnapshotManifest(
+            height=height, format=SNAPSHOT_FORMAT,
+            chunk_size=self.chunk_size, chunk_hashes=tuple(hashes),
+            root=hmerkle.root_from_leaf_hashes(hashes),
+            app_hash=state.app_hash)
+        sdir = self.snapshot_dir(height)
+        os.makedirs(sdir, exist_ok=True)
+        for i, chunk in enumerate(chunks):
+            with open(self._chunk_path(sdir, i), "wb") as f:
+                f.write(chunk)
+                f.flush()
+                os.fsync(f.fileno())
+            fail_point("Snapshot.chunkWritten")
+        fail_point("Snapshot.chunksWritten")
+        tmp = os.path.join(sdir, MANIFEST_NAME + ".tmp")
+        with open(tmp, "wb") as f:
+            f.write(manifest.encode_json())
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(sdir, MANIFEST_NAME))
+        self.prune_retained()
+        dt = time.time() - t0
+        REGISTRY.snapshots_created.inc()
+        REGISTRY.snapshot_create_seconds.observe(dt)
+        log.info("snapshot created", height=height,
+                 chunks=len(chunks), bytes=len(payload),
+                 seconds=round(dt, 3))
+        return manifest
+
+    # -- scan / load ----------------------------------------------------
+    def scan(self) -> tuple[list[SnapshotManifest], list[tuple[str, str]]]:
+        """(valid manifests ascending by height, [(dir, why)] rejects).
+        Scanning never raises on a bad snapshot — a torn dir is evidence
+        of a crash, not an error to propagate."""
+        valid: list[SnapshotManifest] = []
+        rejects: list[tuple[str, str]] = []
+        try:
+            names = sorted(os.listdir(self.root_dir))
+        except FileNotFoundError:
+            return [], []
+        for name in names:
+            sdir = os.path.join(self.root_dir, name)
+            if not name.startswith("snapshot-") or not os.path.isdir(sdir):
+                continue
+            mpath = os.path.join(sdir, MANIFEST_NAME)
+            if not os.path.exists(mpath):
+                rejects.append((sdir, "no manifest (torn create)"))
+                continue
+            try:
+                with open(mpath, "rb") as f:
+                    m = SnapshotManifest.decode_json(f.read())
+            except (OSError, ValueError) as e:
+                rejects.append((sdir, str(e)))
+                continue
+            if self.snapshot_dir(m.height) != sdir:
+                rejects.append((sdir, f"manifest height {m.height} does "
+                                      f"not match directory name"))
+                continue
+            valid.append(m)
+        return valid, rejects
+
+    def list(self) -> list[SnapshotManifest]:
+        return self.scan()[0]
+
+    def best(self) -> SnapshotManifest | None:
+        valid = self.list()
+        return valid[-1] if valid else None
+
+    def load_manifest(self, height: int) -> SnapshotManifest | None:
+        mpath = os.path.join(self.snapshot_dir(height), MANIFEST_NAME)
+        try:
+            with open(mpath, "rb") as f:
+                return SnapshotManifest.decode_json(f.read())
+        except (OSError, ValueError):
+            return None
+
+    def load_chunk(self, height: int, index: int) -> bytes | None:
+        try:
+            with open(self._chunk_path(self.snapshot_dir(height), index),
+                      "rb") as f:
+                return f.read()
+        except OSError:
+            return None
+
+    # -- verify (the `cli snapshot verify` engine) ----------------------
+    def verify(self, height: int) -> dict:
+        """Re-hash every chunk against the manifest.  Returns
+        {height, ok, manifest_ok, chunks, bad_chunks, missing_chunks};
+        `ok` only when the manifest validates AND every chunk is present
+        and hashes to its commitment."""
+        report = {"height": height, "ok": False, "manifest_ok": False,
+                  "chunks": 0, "bad_chunks": [], "missing_chunks": []}
+        m = self.load_manifest(height)
+        if m is None:
+            return report
+        report["manifest_ok"] = True
+        report["chunks"] = m.chunks
+        present: dict[int, bytes] = {}
+        for i in range(m.chunks):
+            chunk = self.load_chunk(height, i)
+            if chunk is None:
+                report["missing_chunks"].append(i)
+            else:
+                present[i] = chunk
+        report["bad_chunks"] = verify_chunk_hashes(present, m.chunk_hashes)
+        report["ok"] = not (report["bad_chunks"]
+                            or report["missing_chunks"])
+        return report
+
+    # -- retention ------------------------------------------------------
+    def delete(self, height: int) -> None:
+        sdir = self.snapshot_dir(height)
+        if not os.path.isdir(sdir):
+            return
+        for name in os.listdir(sdir):
+            try:
+                os.unlink(os.path.join(sdir, name))
+            except OSError:
+                pass
+        try:
+            os.rmdir(sdir)
+        except OSError:
+            pass
+
+    def prune_retained(self) -> list[int]:
+        """Keep the newest `retain` VALID snapshots; drop the rest (and
+        any torn directory older than the newest valid one — a torn dir
+        NEWER than every valid snapshot is kept for post-mortem)."""
+        valid, rejects = self.scan()
+        dropped: list[int] = []
+        for m in valid[:-self.retain] if len(valid) > self.retain else []:
+            self.delete(m.height)
+            dropped.append(m.height)
+        if valid:
+            newest = self.snapshot_dir(valid[-1].height)
+            for sdir, _why in rejects:
+                if sdir < newest:
+                    for name in os.listdir(sdir):
+                        try:
+                            os.unlink(os.path.join(sdir, name))
+                        except OSError:
+                            pass
+                    try:
+                        os.rmdir(sdir)
+                    except OSError:
+                        pass
+        return dropped

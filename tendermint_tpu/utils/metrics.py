@@ -265,6 +265,16 @@ class Registry:
         self.vote_microbatch_lanes = Counter()
         # sync plane
         self.blocks_synced = Counter()
+        # state-sync / snapshot plane (statesync/): chunks_verified vs
+        # chunks_rejected is the no-silent-acceptance ledger — every
+        # fetched chunk lands in exactly one of the two, and a rejected
+        # chunk always carries a peer blame on the switch
+        self.snapshots_created = Counter()
+        self.snapshot_create_seconds = Summary()
+        self.snapshot_restore_seconds = Summary()
+        self.chunks_verified = Counter()
+        self.chunks_rejected = Counter()
+        self.restore_replay_blocks = Counter()  # snapshot_height -> tip
         # p2p plane
         self.peers = Gauge()
         self.msgs_sent = Counter()
@@ -332,6 +342,14 @@ class Registry:
             "vote_microbatches": self.vote_microbatches.value,
             "vote_microbatch_lanes": self.vote_microbatch_lanes.value,
             "blocks_synced": self.blocks_synced.value,
+            "snapshots_created": self.snapshots_created.value,
+            "snapshot_create_seconds_mean":
+                round(self.snapshot_create_seconds.mean, 6),
+            "snapshot_restore_seconds_mean":
+                round(self.snapshot_restore_seconds.mean, 6),
+            "chunks_verified": self.chunks_verified.value,
+            "chunks_rejected": self.chunks_rejected.value,
+            "restore_replay_blocks": self.restore_replay_blocks.value,
             "peers": self.peers.value,
             "p2p_msgs_sent": self.msgs_sent.value,
             "p2p_msgs_received": self.msgs_received.value,

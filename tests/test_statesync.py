@@ -1,0 +1,558 @@
+"""State snapshots + verified snapshot-join recovery.
+
+Covers the statesync subsystem end to end: payload/manifest codecs and
+their torn-write rejection, SnapshotStore create/scan/verify/retention,
+the StateSyncer trust chain (offer grouping, light-client cross-check,
+chunk-hash blame, apply cross-checks), BlockStore base/prune/bootstrap,
+the wire message codec, and the `cli snapshot` commands.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import shutil
+import zlib
+
+import pytest
+
+from tendermint_tpu.abci.app import create_app
+from tendermint_tpu.abci.apps.kvstore import KVStoreApp, PersistentKVStoreApp
+from tendermint_tpu.blockchain.store import BlockStore
+from tendermint_tpu.crypto import backend as cb
+from tendermint_tpu.proxy import ClientCreator
+from tendermint_tpu.state import execution
+from tendermint_tpu.state.state import get_state
+from tendermint_tpu.statesync import messages as sm
+from tendermint_tpu.statesync.restore import (RestoreError, StateSyncer,
+                                              StoreSource,
+                                              verify_manifest_app_hash)
+from tendermint_tpu.statesync.snapshot import (MANIFEST_NAME,
+                                               SnapshotManifest,
+                                               SnapshotStore,
+                                               _device_hash_enabled,
+                                               decode_payload,
+                                               encode_payload, hash_chunks,
+                                               split_chunks,
+                                               verify_chunk_hashes)
+from tendermint_tpu.types import merkle as hmerkle
+from tendermint_tpu.utils import fail
+from tendermint_tpu.utils.db import MemDB
+
+from chainutil import (build_chain, kvstore_app_hashes, make_genesis,
+                       make_validators)
+
+
+@pytest.fixture(autouse=True)
+def _python_backend():
+    old = cb._current
+    cb.set_backend("python")
+    yield
+    cb._current = old
+
+
+def _built(chain_id: str, n: int, tpb: int = 2, nvals: int = 2,
+           seed: int = 3, on_applied=None):
+    """A chain applied through a real kvstore app; returns
+    (chain, gen, state, app, block_store)."""
+    privs, vs = make_validators(nvals, seed=seed)
+    gen = make_genesis(chain_id, privs)
+    hashes = kvstore_app_hashes(n, tpb)
+    chain = build_chain(privs, vs, chain_id, n, txs_per_block=tpb,
+                        app_hashes=hashes)
+    state = get_state(MemDB(), gen)
+    app = create_app("kvstore")
+    conns = ClientCreator(app).new_app_conns()
+    store = BlockStore(MemDB())
+    for block, ps, seen in chain:
+        store.save_block(block, ps, seen)
+        execution.apply_block(state, None, conns.consensus, block,
+                              ps.header, execution.MockMempool(),
+                              check_last_commit=False)
+        if on_applied is not None:
+            on_applied(block.height, state, app)
+    return chain, gen, state, app, store
+
+
+# -- payload + chunk codec --------------------------------------------------
+
+def test_payload_roundtrip():
+    s, a = b"state-bytes", b"app-bytes" * 100
+    assert decode_payload(encode_payload(s, a)) == (s, a)
+    assert decode_payload(encode_payload(b"", b"")) == (b"", b"")
+
+
+def test_payload_truncation_rejected():
+    full = encode_payload(b"state", b"app-state")
+    for cut in (0, 2, 6, len(full) - 1):
+        with pytest.raises(ValueError):
+            decode_payload(full[:cut])
+    with pytest.raises(ValueError):
+        decode_payload(full + b"x")   # trailing garbage
+
+
+def test_split_chunks():
+    payload = bytes(range(256)) * 10
+    chunks = split_chunks(payload, 1000)
+    assert b"".join(chunks) == payload
+    assert [len(c) for c in chunks] == [1000, 1000, 560]
+    assert split_chunks(b"", 64) == [b""]
+    with pytest.raises(ValueError):
+        split_chunks(payload, 0)
+
+
+def test_hash_chunks_matches_host_tree():
+    # odd sizes, a short tail, and a count past the device threshold —
+    # with the python crypto rung installed the gate keeps everything on
+    # the host path, which must equal the host tree leaf-by-leaf
+    for chunks in ([b""], [b"abc"], [b"x" * 64] * 3 + [b"tail"],
+                   [bytes([i]) * 128 for i in range(12)]):
+        assert hash_chunks(chunks) == [hmerkle.leaf_hash(c)
+                                       for c in chunks]
+
+
+def test_verify_chunk_hashes_flags_bad_indices():
+    chunks = [bytes([i]) * 100 for i in range(5)]
+    expected = tuple(hash_chunks(chunks))
+    good = dict(enumerate(chunks))
+    assert verify_chunk_hashes(good, expected) == []
+    tampered = dict(good)
+    tampered[1] = b"\xff" + tampered[1][1:]
+    tampered[4] = tampered[4][:-1] + b"\x00"
+    assert verify_chunk_hashes(tampered, expected) == [1, 4]
+
+
+def test_device_hash_gate(monkeypatch):
+    # python rung installed (autouse fixture) -> host path
+    assert not _device_hash_enabled()
+    monkeypatch.setenv("TM_SNAPSHOT_DEVICE_HASH", "1")
+    assert _device_hash_enabled()
+    monkeypatch.setenv("TM_SNAPSHOT_DEVICE_HASH", "0")
+    assert not _device_hash_enabled()
+
+
+# -- manifest ---------------------------------------------------------------
+
+def _manifest_for(chunks: list[bytes],
+                  app_hash: bytes = b"\x0a" * 20) -> SnapshotManifest:
+    hashes = tuple(hash_chunks(chunks))
+    return SnapshotManifest(
+        height=7, format=1, chunk_size=max(len(c) for c in chunks),
+        chunk_hashes=hashes,
+        root=hmerkle.root_from_leaf_hashes(list(hashes)),
+        app_hash=app_hash)
+
+
+def test_manifest_roundtrip():
+    m = _manifest_for([b"aaaa", b"bbbb", b"cc"])
+    assert SnapshotManifest.decode_json(m.encode_json()) == m
+
+
+def test_manifest_crc_rejects_torn_write():
+    raw = _manifest_for([b"aaaa", b"bb"]).encode_json()
+    with pytest.raises(ValueError, match="torn manifest"):
+        SnapshotManifest.decode_json(raw[:len(raw) // 2])
+    # a bit flip inside a hex field survives JSON parsing but not CRC
+    flipped = raw.replace(b'"height": 7', b'"height": 8')
+    with pytest.raises(ValueError, match="crc32"):
+        SnapshotManifest.decode_json(flipped)
+
+
+def test_manifest_schema_and_root_rejected():
+    m = _manifest_for([b"aaaa", b"bb"])
+    d = json.loads(m.encode_json())
+    d["schema"] = "something-else/9"
+    with pytest.raises(ValueError, match="manifest"):
+        SnapshotManifest.decode_json(json.dumps(d).encode())
+    # chunk hashes that don't re-root: lie about the root, re-CRC so
+    # only the root re-check can object
+    lying = dataclasses.replace(m, root=b"\x13" * 32)
+    with pytest.raises(ValueError, match="re-root"):
+        SnapshotManifest.decode_json(lying.encode_json())
+
+
+def test_manifest_key_includes_app_hash():
+    m = _manifest_for([b"aaaa"])
+    forged = dataclasses.replace(m, app_hash=b"\x66" * 20)
+    assert m.key() != forged.key()   # forged offers never group with
+    #                                  honest ones that share the chunks
+
+
+# -- snapshot store ---------------------------------------------------------
+
+def test_store_create_verify_retention(tmp_path):
+    store = SnapshotStore(str(tmp_path / "snaps"), chunk_size=256,
+                          retain=2)
+    snapped: list[int] = []
+
+    def hook(height, st, app):
+        if height % 2 == 0:
+            store.create(st, app.snapshot_state())
+            snapped.append(height)
+
+    _built("snap-store", 8, on_applied=hook)
+    assert snapped == [2, 4, 6, 8]
+    assert [m.height for m in store.list()] == [6, 8]   # retain=2
+    best = store.best()
+    assert best.height == 8
+    assert store.verify(8)["ok"]
+    assert store.load_manifest(8) == best
+    assert store.load_chunk(8, 0) is not None
+    assert store.load_chunk(8, best.chunks) is None
+
+
+def test_store_detects_corrupt_and_missing_chunks(tmp_path):
+    store = SnapshotStore(str(tmp_path / "snaps"), chunk_size=128)
+    _built("snap-corrupt", 4,
+           on_applied=lambda h, st, app: h == 4 and store.create(
+               st, app.snapshot_state()))
+    m = store.best()
+    assert m.chunks >= 2
+    cpath = os.path.join(store.snapshot_dir(4), "chunk-000000.bin")
+    data = bytearray(open(cpath, "rb").read())
+    data[0] ^= 0xFF
+    open(cpath, "wb").write(bytes(data))
+    rep = store.verify(4)
+    assert not rep["ok"] and rep["bad_chunks"] == [0]
+    os.unlink(cpath)
+    rep = store.verify(4)
+    assert not rep["ok"] and rep["missing_chunks"] == [0]
+
+
+def test_store_torn_create_rejected_on_scan(tmp_path, monkeypatch):
+    store = SnapshotStore(str(tmp_path / "snaps"), chunk_size=128)
+
+    class Crash(Exception):
+        pass
+
+    def hook(height, st, app):
+        if height != 4:
+            return
+        monkeypatch.setenv("TM_FAIL_POINT", "Snapshot.chunksWritten")
+        fail.set_callback(lambda name, idx: (_ for _ in ()).throw(
+            Crash(name)))
+        try:
+            with pytest.raises(Crash):
+                store.create(st, app.snapshot_state())
+        finally:
+            monkeypatch.delenv("TM_FAIL_POINT")
+            fail.set_callback(None)
+
+    _built("snap-torn", 4, on_applied=hook)
+    valid, rejects = store.scan()
+    assert valid == []
+    assert len(rejects) == 1 and "torn create" in rejects[0][1]
+    assert store.best() is None
+
+
+def test_store_rejects_height_dir_mismatch(tmp_path):
+    store = SnapshotStore(str(tmp_path / "snaps"), chunk_size=128)
+    _built("snap-dirname", 4,
+           on_applied=lambda h, st, app: h == 4 and store.create(
+               st, app.snapshot_state()))
+    os.rename(store.snapshot_dir(4), store.snapshot_dir(5))
+    valid, rejects = store.scan()
+    assert valid == []
+    assert len(rejects) == 1 and "does not match" in rejects[0][1]
+
+
+# -- the syncer trust chain -------------------------------------------------
+
+def _snapshotted(tmp_path, name: str, n: int = 8, interval: int = 3,
+                 nvals: int = 2):
+    """A chain with snapshots at 3 and 6 (below the tip, so a verified
+    successor header exists for the light-client cross-check) + a parity
+    reference per snapshot height.  Returns (chain, gen, store,
+    captured) with captured[h] == (state_bytes, app_hash)."""
+    store = SnapshotStore(str(tmp_path / name), chunk_size=200, retain=8)
+    captured: dict[int, tuple[bytes, bytes]] = {}
+
+    def hook(height, st, app):
+        if height % interval == 0:
+            store.create(st, app.snapshot_state())
+            captured[height] = (st.encode(),
+                                app.info().last_block_app_hash)
+
+    chain, gen, _state, _app, _bs = _built(name, n, nvals=nvals,
+                                           on_applied=hook)
+    return chain, gen, store, captured
+
+
+def _offer_verifier(chain):
+    headers = {b.height: b.header for b, _ps, _sc in chain}
+    return lambda m: (headers.get(m.height + 1) is not None
+                      and verify_manifest_app_hash(
+                          m, headers[m.height + 1]))
+
+
+def test_restore_parity_byte_identical(tmp_path):
+    chain, gen, store, captured = _snapshotted(tmp_path, "sync-parity")
+    syncer = StateSyncer([StoreSource("src", store)],
+                         verify_offer=_offer_verifier(chain))
+    app = create_app("kvstore")
+    state, manifest = syncer.restore(MemDB(), gen, app)
+    assert manifest.height == 6
+    ref_state, ref_app_hash = captured[6]
+    assert state.encode() == ref_state
+    assert app.info().last_block_app_hash == ref_app_hash
+    assert syncer.blamed == []
+
+
+def test_offers_group_and_order(tmp_path):
+    chain, _gen, store, _cap = _snapshotted(tmp_path, "sync-offers")
+    dup = SnapshotStore(str(tmp_path / "sync-offers-dup"))
+    shutil.copytree(store.root_dir, dup.root_dir, dirs_exist_ok=True)
+    solo = SnapshotStore(str(tmp_path / "sync-offers-solo"))
+    shutil.copytree(store.root_dir, solo.root_dir, dirs_exist_ok=True)
+    solo.delete(6)
+
+    class Broken:
+        peer_id = "down"
+
+        def manifests(self):
+            raise OSError("unreachable")
+
+    syncer = StateSyncer([StoreSource("a", store), StoreSource("b", dup),
+                          StoreSource("c", solo), Broken()])
+    offers = syncer.offers()
+    # height desc; at equal height more providers first
+    assert [(m.height, len(srcs)) for m, srcs in offers] == \
+        [(6, 2), (3, 3)]
+    assert syncer.blamed == []   # unreachable is not malicious
+
+
+def test_tampered_chunks_blamed_and_refetched(tmp_path):
+    chain, gen, store, captured = _snapshotted(tmp_path, "sync-tamper")
+    evil = SnapshotStore(str(tmp_path / "sync-tamper-evil"))
+    shutil.copytree(store.root_dir, evil.root_dir, dirs_exist_ok=True)
+    best_below_tip = 6
+    sdir = evil.snapshot_dir(best_below_tip)
+    for name in os.listdir(sdir):
+        if name == MANIFEST_NAME:
+            continue
+        path = os.path.join(sdir, name)
+        data = bytearray(open(path, "rb").read())
+        data[0] ^= 0x5A
+        open(path, "wb").write(bytes(data))
+    reports: list[tuple[str, bool]] = []
+    syncer = StateSyncer(
+        [StoreSource("evil", evil), StoreSource("good", store)],
+        report_misbehavior=lambda pid, reason, ban=False:
+            reports.append((pid, ban)),
+        verify_offer=_offer_verifier(chain))
+    app = create_app("kvstore")
+    state, manifest = syncer.restore(MemDB(), gen, app)
+    assert manifest.height == best_below_tip
+    assert state.encode() == captured[best_below_tip][0]
+    assert {pid for pid, _ in reports} == {"evil"}
+    assert all(ban for _pid, ban in reports)
+    assert all(pid == "evil" for pid, _r in syncer.blamed)
+
+
+def test_forged_offer_blamed_via_light_client_check(tmp_path):
+    chain, gen, store, captured = _snapshotted(tmp_path, "sync-forge")
+    forge = SnapshotStore(str(tmp_path / "sync-forge-evil"))
+    honest = store.load_manifest(6)
+    src = store.snapshot_dir(6)
+    dst = forge.snapshot_dir(7)   # later height -> tried first
+    os.makedirs(dst, exist_ok=True)
+    for name in os.listdir(src):
+        if name != MANIFEST_NAME:
+            shutil.copy(os.path.join(src, name), os.path.join(dst, name))
+    forged = dataclasses.replace(honest, height=7,
+                                 app_hash=b"\x77" * 20)
+    open(os.path.join(dst, MANIFEST_NAME), "wb").write(
+        forged.encode_json())
+    syncer = StateSyncer(
+        [StoreSource("forger", forge), StoreSource("honest", store)],
+        verify_offer=_offer_verifier(chain))
+    app = create_app("kvstore")
+    state, manifest = syncer.restore(MemDB(), gen, app)
+    assert manifest.height == 6           # fell through to the honest one
+    assert state.encode() == captured[6][0]
+    assert ("forger" in {p for p, _ in syncer.blamed}
+            and "honest" not in {p for p, _ in syncer.blamed})
+
+
+def test_exhausted_offers_raise_restore_error(tmp_path):
+    chain, gen, store, _cap = _snapshotted(tmp_path, "sync-exhaust")
+    for h in (3, 6):
+        sdir = store.snapshot_dir(h)
+        for name in os.listdir(sdir):
+            if name != MANIFEST_NAME:
+                path = os.path.join(sdir, name)
+                data = bytearray(open(path, "rb").read())
+                data[-1] ^= 0x01
+                open(path, "wb").write(bytes(data))
+    syncer = StateSyncer([StoreSource("only", store)])
+    with pytest.raises(RestoreError, match="fall back to full"):
+        syncer.restore(MemDB(), gen, create_app("kvstore"))
+    assert syncer.blamed   # every bad serve was charged
+
+
+def test_stale_offer_blames_all_providers(tmp_path):
+    _chain, gen, store, _cap = _snapshotted(tmp_path, "sync-stale")
+    syncer = StateSyncer([StoreSource("stale", store)],
+                         verify_offer=lambda m: False)
+    with pytest.raises(RestoreError):
+        syncer.restore(MemDB(), gen, create_app("kvstore"))
+    assert all(p == "stale" and "cross-check" in r
+               for p, r in syncer.blamed)
+
+
+def test_apply_rejects_wrong_chain_id(tmp_path):
+    _chain, _gen, store, _cap = _snapshotted(tmp_path, "sync-chainid")
+    privs, _vs = make_validators(2, seed=9)
+    other_gen = make_genesis("a-different-chain", privs)
+    syncer = StateSyncer([StoreSource("src", store)])
+    with pytest.raises(RestoreError):
+        syncer.restore(MemDB(), other_gen, create_app("kvstore"))
+    assert any("chain_id" in r for _p, r in syncer.blamed)
+
+
+# -- block store base / prune / bootstrap -----------------------------------
+
+def test_blockstore_prune_and_base(tmp_path):
+    _chain, _gen, _state, _app, store = _built("bs-prune", 8)
+    assert store.base == 1 and store.height == 8
+    assert store.prune(5) == 4      # dropped 1..4
+    assert store.base == 5
+    assert store.load_block(4) is None
+    assert store.load_block(5) is not None
+    assert store.load_block_meta(4) is None
+    assert store.load_seen_commit(4) is None
+    # the commit FOR height 4 rides in retained block 5 and survives
+    assert store.load_block_commit(4) is not None
+    assert store.prune(3) == 0      # below base: no-op
+    with pytest.raises(ValueError):
+        store.prune(10)             # beyond height+1
+    # reopening the same db keeps the base
+    reopened = BlockStore(store.db)
+    assert reopened.base == 5 and reopened.height == 8
+
+
+def test_blockstore_bootstrap(tmp_path):
+    store = BlockStore(MemDB())
+    store.bootstrap(500)
+    assert store.height == 500 and store.base == 501
+    assert store.load_block(500) is None
+    _chain, _gen, _state, _app, full = _built("bs-boot", 4)
+    with pytest.raises(ValueError):
+        full.bootstrap(10)          # refuses a non-empty store
+
+
+# -- wire messages ----------------------------------------------------------
+
+def test_statesync_message_roundtrip():
+    m = _manifest_for([b"aaaa", b"bb"])
+    for msg in (sm.SnapshotsRequest(),
+                sm.SnapshotsResponse(manifests=(m,)),
+                sm.ChunkRequest(height=500, index=3),
+                sm.ChunkResponse(height=500, index=3, chunk=b"\x01" * 64),
+                sm.NoChunkResponse(height=500, index=9)):
+        assert sm.decode_msg(sm.encode_msg(msg)) == msg
+    with pytest.raises(ValueError):
+        sm.decode_msg(b"\xee")
+
+
+def test_statesync_response_carries_crc_frame():
+    # a manifest corrupted in flight fails its own CRC at decode
+    m = _manifest_for([b"aaaa", b"bb"])
+    raw = bytearray(sm.encode_msg(sm.SnapshotsResponse(manifests=(m,))))
+    at = raw.index(b'"height"') + len(b'"height": ')
+    raw[at] ^= 0x01
+    with pytest.raises(ValueError):
+        sm.decode_msg(bytes(raw))
+
+
+# -- kvstore snapshot seam --------------------------------------------------
+
+def test_kvstore_snapshot_state_roundtrip():
+    src = KVStoreApp()
+    for i in range(300):
+        src.deliver_tx(b"k%d=v%d" % (i, i))
+    src.commit()
+    blob = src.snapshot_state()
+    dst = KVStoreApp()
+    dst.restore_state(blob)
+    assert dst.state == src.state and dst.height == src.height
+    assert (dst.info().last_block_app_hash
+            == src.info().last_block_app_hash)
+    with pytest.raises(ValueError):
+        dst.restore_state(blob[:len(blob) - 3])
+
+
+# -- cli --------------------------------------------------------------------
+
+def _make_home(tmp_path, name: str, gen) -> tuple[str, str]:
+    """A CLI home with config.toml (persistent_kvstore) + genesis;
+    returns (home, db_dir)."""
+    from tendermint_tpu.config import (Config, config_file,
+                                       save_config_file)
+    home = str(tmp_path / name)
+    cfg = Config()
+    cfg.base.home = home
+    cfg.base.proxy_app = "persistent_kvstore"
+    os.makedirs(cfg.base.db_dir(), exist_ok=True)
+    gen.save(cfg.base.genesis_file())
+    save_config_file(cfg, config_file(home))
+    return home, cfg.base.db_dir()
+
+
+def test_cli_snapshot_flow(tmp_path, monkeypatch, capsys):
+    from tendermint_tpu.cli import main
+    from tendermint_tpu.utils.db import new_db
+
+    chain_id = "cli-snap"
+    privs, vs = make_validators(2, seed=4)
+    gen = make_genesis(chain_id, privs)
+    n = 6
+    hashes = kvstore_app_hashes(n)
+    chain = build_chain(privs, vs, chain_id, n, app_hashes=hashes)
+
+    # source home: sqlite state at height 6 + persisted kvstore app
+    home1, db1 = _make_home(tmp_path, "home1", gen)
+    app = PersistentKVStoreApp(os.path.join(db1, "kvstore_app.json"))
+    conns = ClientCreator(app).new_app_conns()
+    state = get_state(new_db("sqlite", os.path.join(db1, "state.db")),
+                      gen)
+    for block, ps, _seen in chain:
+        execution.apply_block(state, None, conns.consensus, block,
+                              ps.header, execution.MockMempool(),
+                              check_last_commit=False)
+    monkeypatch.setenv("TM_KVSTORE_PATH",
+                       os.path.join(db1, "kvstore_app.json"))
+    assert main(["--home", home1, "snapshot", "create"]) == 0
+    assert main(["--home", home1, "snapshot", "list"]) == 0
+    out = capsys.readouterr().out
+    assert f"height {n}" in out
+    snap_root = os.path.join(db1, "snapshots")
+    assert main(["--home", home1, "snapshot", "verify", snap_root]) == 0
+
+    # restore into a fresh home
+    home2, db2 = _make_home(tmp_path, "home2", gen)
+    monkeypatch.setenv("TM_KVSTORE_PATH",
+                       os.path.join(db2, "kvstore_app.json"))
+    assert main(["--home", home2, "snapshot", "restore",
+                 "--dir", snap_root]) == 0
+    restored = get_state(new_db("sqlite",
+                                os.path.join(db2, "state.db")), gen)
+    assert restored.encode() == state.encode()
+    bs = BlockStore(new_db("sqlite", os.path.join(db2, "blockstore.db")))
+    assert bs.height == n and bs.base == n + 1
+    assert json.load(open(os.path.join(
+        db2, "kvstore_app.json")))["height"] == n
+    # a second restore refuses the now-populated data dir
+    assert main(["--home", home2, "snapshot", "restore",
+                 "--dir", snap_root]) == 1
+
+    # corrupt one chunk: verify flags it and exits nonzero
+    sdir = os.path.join(snap_root, f"snapshot-{n:010d}")
+    cpath = os.path.join(sdir, "chunk-000000.bin")
+    data = bytearray(open(cpath, "rb").read())
+    data[0] ^= 0xFF
+    open(cpath, "wb").write(bytes(data))
+    capsys.readouterr()
+    assert main(["--home", home1, "snapshot", "verify", snap_root]) == 1
+    assert "corrupt" in capsys.readouterr().out

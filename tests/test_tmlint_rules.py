@@ -432,6 +432,52 @@ def test_scenario_budget_quiet_on_smoke_and_budgeted(tmp_path):
     assert [f for f in findings if f.rule == "scenario-budget"] == []
 
 
+def test_scenario_budget_statesync_registration_shapes(tmp_path):
+    # Golden twin of the statesync scenario registrations: a stress rig
+    # whose budgets carry only "min" bounds (a speedup floor is still a
+    # budget), a stress rig mixing min and max bounds, and a smoke-tier
+    # torn-tail probe with no budgets at all.  All three are compliant;
+    # the variant that drops the budgets kwarg is not.
+    findings = lint_src(tmp_path, """
+        from tendermint_tpu.scenarios.engine import register
+
+        def _safety(ctx, obs):
+            pass
+
+        @register("snapshot-join-twin", "rejoin from snapshot",
+                  safety=[("restore-parity", _safety)],
+                  liveness=[("victim-synced", _safety)],
+                  smoke=False, budget_s=420.0,
+                  budgets={"catchup_speedup_x": {"min": 10.0}})
+        def join_twin(ctx):
+            return {}
+
+        @register("snapshot-tamper-twin", "reject corrupted chunks",
+                  safety=[("no-silent-acceptance", _safety)],
+                  liveness=[("restored", _safety)],
+                  smoke=False, budget_s=120.0,
+                  budgets={"tamper_restore_s": {"max": 30.0},
+                           "tamper_chunks_rejected": {"min": 1.0}})
+        def tamper_twin(ctx):
+            return {}
+
+        @register("snapshot-torn-tail-twin", "recover past torn tail",
+                  safety=[("torn-discarded", _safety)],
+                  liveness=[("replayed", _safety)], smoke=True)
+        def torn_twin(ctx):
+            return {}
+
+        @register("snapshot-join-naked", "stress rig, no budgets",
+                  safety=[("s", _safety)], liveness=[("l", _safety)],
+                  smoke=False, budget_s=420.0)
+        def join_naked(ctx):
+            return {}
+        """)
+    hits = [f for f in findings if f.rule == "scenario-budget"]
+    assert len(hits) == 1, findings
+    assert "snapshot-join-naked" in hits[0].message
+
+
 def test_rule_catalog_covers_all_families():
     from tendermint_tpu.analysis import all_rules
     names = {n for n, _ in all_rules()}
