@@ -1454,7 +1454,9 @@ def main() -> None:
     report = regressions = None
     try:
         from tendermint_tpu.utils import attribution
-        report = attribution.doctor_report(tracing.RECORDER.snapshot())
+        from tendermint_tpu.utils.metrics import REGISTRY as _reg
+        report = attribution.doctor_report(tracing.RECORDER.snapshot(),
+                                           metrics=_reg.snapshot())
         for w in report["windows"]:
             attribution.observe_window_metrics(w)
     except Exception as e:

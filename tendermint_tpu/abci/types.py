@@ -13,6 +13,7 @@ from dataclasses import dataclass, field
 OK = 0
 ERR_ENCODING = 1
 ERR_BAD_NONCE = 2
+ERR_BAD_SIG = 3
 ERR_UNKNOWN = 99
 
 

@@ -20,6 +20,8 @@ Rule families (see each module's docstring for details):
 - `hotpath.py`   jax-host-sync / jax-retrace / jax-static-argnums
 - `conventions.py` route-gating / route-write-containment /
                  span-category / metric-name
+- `batchplane_rule.py` batchplane-producer (verify work must ride the
+                 shared device batch plane)
 
 Suppression and grandfathering:
 
@@ -35,7 +37,8 @@ from tendermint_tpu.analysis.core import (Finding, LintResult, all_rules,
                                           load_baseline, save_baseline)
 
 # importing the rule modules registers their rule classes
-from tendermint_tpu.analysis import conventions, hotpath, locks  # noqa: E402,F401  (registration import)
+from tendermint_tpu.analysis import (batchplane_rule, conventions,  # noqa: E402,F401  (registration import)
+                                     hotpath, locks)
 
 __all__ = ["Finding", "LintResult", "all_rules", "baseline_path",
            "lint_paths", "load_baseline", "save_baseline"]

@@ -56,6 +56,7 @@ _INSTRUMENT_CTORS = {
     "Histogram": ("_bucket", "_sum", "_count"),
     "CounterVec": (),
     "GaugeVec": (),
+    "HistogramVec": ("_bucket", "_sum", "_count"),
 }
 
 _WRITE_CALLS = {"os.replace", "os.remove", "os.unlink", "os.rename",
@@ -267,7 +268,7 @@ class MetricNameRule(Rule):
                     f"generated by another instrument (corrupts the "
                     f"scrape)")
             series[full] = call
-        if ctor in ("CounterVec", "GaugeVec") and call.args:
+        if ctor in ("CounterVec", "GaugeVec", "HistogramVec") and call.args:
             label = _str_const(call.args[0])
             if label is not None:
                 if not _LABEL_NAME_RE.match(label):

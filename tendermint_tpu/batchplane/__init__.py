@@ -1,0 +1,26 @@
+"""Unified device batch plane — one verify scheduler for every
+workload (see `scheduler.py` for the full contract).
+
+Producers import THIS surface instead of calling `crypto.backend`
+directly; tmlint's `batchplane` rule enforces it for the hot-path
+modules (consensus/, light/, mempool/, blockchain/).
+"""
+
+from tendermint_tpu.batchplane.scheduler import (BatchPlane,
+                                                 CLASS_CONSENSUS,
+                                                 CLASS_FASTSYNC,
+                                                 CLASS_LIGHT,
+                                                 CLASS_MEMPOOL,
+                                                 CLASS_PRIORITY,
+                                                 Submission, enabled,
+                                                 get_plane, reset_plane,
+                                                 verify_batch,
+                                                 verify_grouped,
+                                                 verify_grouped_templated,
+                                                 verify_secp)
+
+__all__ = ["BatchPlane", "CLASS_CONSENSUS", "CLASS_FASTSYNC",
+           "CLASS_LIGHT", "CLASS_MEMPOOL", "CLASS_PRIORITY",
+           "Submission", "enabled", "get_plane", "reset_plane",
+           "verify_batch", "verify_grouped", "verify_grouped_templated",
+           "verify_secp"]

@@ -1,0 +1,519 @@
+"""The unified device batch plane: ONE verify scheduler for every
+workload.
+
+Before this module, each producer of signature-verify work — consensus
+vote ingest, fast-sync window verify, light-client chain verifies, and
+mempool CheckTx — micro-batched onto the device independently, so
+concurrent workloads fought for the chip and padded separate half-full
+batches.  This is the Blockchain Machine architecture (arXiv:2104.06968)
+applied to the jax_graft crypto plane: a single submission queue
+coalesces lanes from ALL producers into the fixed pre-warmed chunk
+shapes the backend already buckets to, and one worker drains it onto the
+supervised crypto ladder.
+
+Scheduling contract:
+
+* **Priority classes.**  Every submission carries a class —
+  ``consensus`` > ``fastsync`` > ``mempool`` > ``light`` — and when more
+  than one coalesced batch is ready to ship, the highest class ships
+  first: consensus votes preempt light-client queries and CheckTx.
+* **Deadline-aware flushing.**  A batch ships when it is FULL (its lane
+  count reaches the chunk target) or when its oldest submission's
+  deadline arrives — latency-sensitive votes never wait on a
+  slow-filling batch, and bulk fast-sync lanes wait just long enough to
+  coalesce.  Each class has a default max queue wait
+  (`TM_BATCHPLANE_WAIT_<CLASS>` overrides, seconds).
+* **Per-producer fairness.**  When a flush must truncate (more lanes
+  queued than the per-flush cap), lanes are taken round-robin across
+  producers, so a flooding producer cannot starve the others out of a
+  batch; leftovers stay queued at their original deadlines.
+* **Fault isolation.**  The flush executes through the module-level
+  `crypto.backend` helpers, i.e. UNDER the SupervisedBackend ladder —
+  DeviceFault blame, `TM_CHAOS_CRYPTO` chaos injection, and rung
+  demotion all apply unchanged.  A DeviceFault mid-batch fails ONLY the
+  submissions in that flush; queued work is untouched and later flushes
+  proceed.
+
+Merging rules follow the backend's entry points: plain grouped lanes
+merge per validator-set key, templated lanes merge per set key with
+template-index rebasing (the `merge_commit_lanes` layout), raw
+per-lane ed25519 lanes merge across ALL producers (the mempool CheckTx
+lane rides next to anything), and secp256k1 lanes coalesce into one
+host-side pass (`crypto/secp256k1.py` is OpenSSL-backed; there is no
+device kernel for it yet, but the queue discipline and fairness are
+identical so a future device lane slots in unchanged).
+
+`TM_BATCHPLANE=0` bypasses the queue entirely (each submission executes
+inline on the caller's thread through the same backend helpers) — the
+escape hatch for single-workload benches that want zero added latency.
+
+Everything is observable: batch-occupancy and queue-depth histograms,
+per-class wait-time histograms, flush-reason and per-producer lane
+counters, and a mixed-batch counter proving cross-producer coalescing
+(see README "Unified batch plane" for the metric table).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+import numpy as np
+
+from tendermint_tpu.utils.log import get_logger
+from tendermint_tpu.utils.metrics import REGISTRY
+
+log = get_logger("batchplane")
+
+# -- priority classes --------------------------------------------------------
+
+CLASS_CONSENSUS = "consensus"
+CLASS_FASTSYNC = "fastsync"
+CLASS_MEMPOOL = "mempool"
+CLASS_LIGHT = "light"
+
+# lower number = higher priority (consensus preempts everything)
+CLASS_PRIORITY = {CLASS_CONSENSUS: 0, CLASS_FASTSYNC: 1,
+                  CLASS_MEMPOOL: 2, CLASS_LIGHT: 3}
+
+# default max queue wait (seconds) before a submission's batch must ship
+# even half-empty: votes are on the live-round critical path, fast-sync
+# windows arrive in bulk and can afford to coalesce longer
+_DEFAULT_WAIT = {CLASS_CONSENSUS: 0.002, CLASS_FASTSYNC: 0.02,
+                 CLASS_MEMPOOL: 0.010, CLASS_LIGHT: 0.025}
+
+
+def class_max_wait(klass: str) -> float:
+    env = os.environ.get(f"TM_BATCHPLANE_WAIT_{klass.upper()}")
+    if env:
+        try:
+            return max(float(env), 0.0)
+        except ValueError:
+            pass
+    return _DEFAULT_WAIT.get(klass, 0.02)
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def enabled() -> bool:
+    return os.environ.get("TM_BATCHPLANE", "1") not in ("0", "false", "no")
+
+
+# -- submissions -------------------------------------------------------------
+
+
+class Submission:
+    """One producer's slice of a future device batch.  `wait()` blocks
+    until the worker flushed the batch and returns this slice's bool
+    lanes — or re-raises the flush's error (DeviceFault et al) so the
+    producer's existing blame handling fires unchanged."""
+
+    __slots__ = ("kind", "key", "producer", "klass", "deadline", "enq_t",
+                 "arrays", "n", "_event", "_result", "_error")
+
+    def __init__(self, kind, key, producer, klass, deadline, arrays, n):
+        self.kind = kind
+        self.key = key
+        self.producer = producer
+        self.klass = klass
+        self.deadline = deadline
+        self.enq_t = time.perf_counter()
+        self.arrays = arrays
+        self.n = n
+        self._event = threading.Event()
+        self._result = None
+        self._error = None
+
+    def _resolve(self, result) -> None:
+        self._result = result
+        self._event.set()
+
+    def _fail(self, exc: BaseException) -> None:
+        self._error = exc
+        self._event.set()
+
+    def wait(self) -> np.ndarray:
+        self._event.wait()
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class _PendingBatch:
+    """Submissions sharing one merge key, in arrival order."""
+
+    __slots__ = ("key", "subs", "lanes")
+
+    def __init__(self, key):
+        self.key = key
+        self.subs: list[Submission] = []
+        self.lanes = 0
+
+    def add(self, sub: Submission) -> None:
+        self.subs.append(sub)
+        self.lanes += sub.n
+
+    @property
+    def priority(self) -> int:
+        return min(CLASS_PRIORITY.get(s.klass, 9) for s in self.subs)
+
+    @property
+    def oldest_deadline(self) -> float:
+        return min(s.deadline for s in self.subs)
+
+
+# -- the plane ---------------------------------------------------------------
+
+
+class BatchPlane:
+    """The shared scheduler.  One instance per process (`get_plane()`);
+    tests construct their own to control knobs and lifetime."""
+
+    def __init__(self, target_lanes: int | None = None,
+                 max_flush_lanes: int | None = None):
+        # a batch is FULL (ships immediately) at target_lanes; one flush
+        # never takes more than max_flush_lanes (fairness truncation)
+        self.target_lanes = (target_lanes if target_lanes is not None
+                             else _env_int("TM_BATCHPLANE_LANES", 1024))
+        self.max_flush_lanes = (
+            max_flush_lanes if max_flush_lanes is not None
+            else _env_int("TM_BATCHPLANE_MAX_FLUSH", 4096))
+        self._cond = threading.Condition()
+        self._pending: dict[tuple, _PendingBatch] = {}
+        self._thread: threading.Thread | None = None
+        self._stopped = False
+        self._inflight = 0          # submissions being executed right now
+
+    # -- submission entry points ----------------------------------------
+
+    def _submit(self, kind, key, producer, klass, arrays, n,
+                max_wait: float | None) -> Submission:
+        wait_s = class_max_wait(klass) if max_wait is None else max_wait
+        sub = Submission(kind, key, producer, klass,
+                         time.perf_counter() + wait_s, arrays, n)
+        if not enabled():
+            self._execute([sub], reason="inline")
+            return sub
+        with self._cond:
+            if self._stopped:
+                raise RuntimeError("batch plane is stopped")
+            batch = self._pending.get(key)
+            if batch is None:
+                batch = self._pending[key] = _PendingBatch(key)
+            batch.add(sub)
+            self._ensure_worker()
+            self._cond.notify_all()
+        return sub
+
+    def submit_grouped(self, set_key: bytes, val_pubs, val_idx, msgs,
+                       sigs, *, producer: str, klass: str,
+                       max_wait: float | None = None) -> Submission:
+        n = len(val_idx)
+        key = ("grouped", bytes(set_key), msgs.shape[-1] if n else 0)
+        arrays = (val_pubs, np.asarray(val_idx, np.int32),
+                  np.asarray(msgs), np.asarray(sigs))
+        return self._submit("grouped", key, producer, klass, arrays, n,
+                            max_wait)
+
+    def submit_templated(self, set_key: bytes, val_pubs, val_idx,
+                         tmpl_idx, templates, sigs, *, producer: str,
+                         klass: str,
+                         max_wait: float | None = None) -> Submission:
+        n = len(val_idx)
+        key = ("templated", bytes(set_key),
+               templates.shape[-1] if len(templates) else 0)
+        arrays = (val_pubs, np.asarray(val_idx, np.int32),
+                  np.asarray(tmpl_idx, np.int32), np.asarray(templates),
+                  np.asarray(sigs))
+        return self._submit("templated", key, producer, klass, arrays, n,
+                            max_wait)
+
+    def submit_raw(self, pubkeys, msgs, sigs, *, producer: str,
+                   klass: str, max_wait: float | None = None) -> Submission:
+        """Per-lane ed25519 verify (pubkeys NOT from a fixed set): the
+        mempool CheckTx lane.  Raw lanes merge across ALL producers."""
+        n = len(sigs)
+        key = ("raw", msgs.shape[-1] if n else 0)
+        arrays = (np.asarray(pubkeys), np.asarray(msgs), np.asarray(sigs))
+        return self._submit("raw", key, producer, klass, arrays, n,
+                            max_wait)
+
+    def submit_secp(self, items: list[tuple[bytes, bytes, bytes]], *,
+                    producer: str, klass: str,
+                    max_wait: float | None = None) -> Submission:
+        """secp256k1 lanes as (pub33, msg, der_sig) tuples — coalesced
+        into one host-side OpenSSL pass (no device kernel yet; same
+        queue discipline so one slots in without touching producers)."""
+        key = ("secp",)
+        return self._submit("secp", key, producer, klass,
+                            (list(items),), len(items), max_wait)
+
+    # -- worker ---------------------------------------------------------
+
+    def _ensure_worker(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._run, name="batchplane", daemon=True)
+            self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while not self._pending and not self._stopped:
+                    self._cond.wait()
+                if self._stopped and not self._pending:
+                    return
+                batch, reason = self._next_flush_locked()
+                if batch is None:
+                    # nothing due yet: sleep until the earliest deadline
+                    horizon = min(b.oldest_deadline
+                                  for b in self._pending.values())
+                    self._cond.wait(
+                        max(horizon - time.perf_counter(), 1e-4))
+                    continue
+                subs = self._take_locked(batch)
+                self._inflight += len(subs)
+                depth = sum(len(b.subs) for b in self._pending.values())
+            REGISTRY.batchplane_queue_depth_hist.observe(depth)
+            try:
+                self._execute(subs, reason)
+            finally:
+                with self._cond:
+                    self._inflight -= len(subs)
+                    self._cond.notify_all()
+
+    def _next_flush_locked(self):
+        """(batch, reason) to flush now, or (None, None) if nothing is
+        full or due.  Full batches beat due batches; among candidates
+        the highest class wins, then the oldest deadline — consensus
+        preempts even an earlier-queued light batch."""
+        now = time.perf_counter()
+        full = [b for b in self._pending.values()
+                if b.lanes >= self.target_lanes]
+        due = [b for b in self._pending.values()
+               if b.oldest_deadline <= now]
+        pick = lambda bs: min(              # noqa: E731 (tiny chooser)
+            bs, key=lambda b: (b.priority, b.oldest_deadline))
+        if full:
+            return pick(full), "full"
+        if due:
+            return pick(due), "deadline"
+        return None, None
+
+    def _take_locked(self, batch: _PendingBatch) -> list[Submission]:
+        """Remove up to max_flush_lanes from `batch`, round-robin across
+        producers so no producer starves out of a truncated flush."""
+        if batch.lanes <= self.max_flush_lanes:
+            del self._pending[batch.key]
+            return batch.subs
+        by_producer: dict[str, list[Submission]] = {}
+        for s in batch.subs:
+            by_producer.setdefault(s.producer, []).append(s)
+        taken, lanes = [], 0
+        queues = list(by_producer.values())
+        while queues and lanes < self.max_flush_lanes:
+            for q in list(queues):
+                if not q:
+                    queues.remove(q)
+                    continue
+                nxt = q[0]
+                if taken and lanes + nxt.n > self.max_flush_lanes:
+                    queues.remove(q)      # would overflow; producer done
+                    continue
+                taken.append(q.pop(0))
+                lanes += nxt.n
+        left = [s for s in batch.subs if s not in taken]
+        if left:
+            nb = _PendingBatch(batch.key)
+            for s in left:
+                nb.add(s)
+            self._pending[batch.key] = nb
+        else:
+            del self._pending[batch.key]
+        # keep arrival order within the flush (stable lane slicing)
+        taken.sort(key=lambda s: s.enq_t)
+        return taken
+
+    # -- execution ------------------------------------------------------
+
+    def _execute(self, subs: list[Submission], reason: str) -> None:
+        now = time.perf_counter()
+        producers = {s.producer for s in subs}
+        lanes = sum(s.n for s in subs)
+        REGISTRY.batchplane_flushes.inc()
+        REGISTRY.batchplane_flush_reason.labels(reason).inc()
+        if len(producers) > 1:
+            REGISTRY.batchplane_mixed_batches.inc()
+        for s in subs:
+            REGISTRY.batchplane_wait_seconds.labels(s.klass).observe(
+                max(now - s.enq_t, 0.0))
+            REGISTRY.batchplane_lanes.labels(s.producer).inc(s.n)
+        if lanes:
+            REGISTRY.batchplane_occupancy_hist.observe(
+                lanes / float(_chunk(max(lanes, 1))))
+        try:
+            kind = subs[0].kind
+            if kind == "grouped":
+                out = self._run_grouped(subs)
+            elif kind == "templated":
+                out = self._run_templated(subs)
+            elif kind == "raw":
+                out = self._run_raw(subs)
+            else:
+                out = self._run_secp(subs)
+        except BaseException as e:                # DeviceFault included:
+            for s in subs:                        # blame ONLY this flush
+                s._fail(e)
+            return
+        off = 0
+        for s in subs:
+            s._resolve(out[off:off + s.n])
+            off += s.n
+
+    @staticmethod
+    def _run_grouped(subs) -> np.ndarray:
+        from tendermint_tpu.crypto import backend as cb
+        set_key = subs[0].key[1]
+        val_pubs = subs[0].arrays[0]
+        idx = np.concatenate([s.arrays[1] for s in subs])
+        msgs = np.concatenate([s.arrays[2] for s in subs])
+        sigs = np.concatenate([s.arrays[3] for s in subs])
+        return cb.verify_grouped(set_key, val_pubs, idx, msgs, sigs)
+
+    @staticmethod
+    def _run_templated(subs) -> np.ndarray:
+        from tendermint_tpu.crypto import backend as cb
+        set_key = subs[0].key[1]
+        val_pubs = subs[0].arrays[0]
+        # rebase each submission's template indices onto the combined
+        # template block (the merge_commit_lanes layout)
+        t_off, tmpl_parts, idx_parts = 0, [], []
+        for s in subs:
+            _vp, _vi, ti, templates, _sg = s.arrays
+            idx_parts.append(ti + t_off)
+            tmpl_parts.append(templates)
+            t_off += len(templates)
+        idx = np.concatenate([s.arrays[1] for s in subs])
+        tmpl_idx = np.concatenate(idx_parts)
+        templates = np.concatenate(tmpl_parts)
+        sigs = np.concatenate([s.arrays[4] for s in subs])
+        return cb.verify_grouped_templated(set_key, val_pubs, idx,
+                                           tmpl_idx, templates, sigs)
+
+    @staticmethod
+    def _run_raw(subs) -> np.ndarray:
+        from tendermint_tpu.crypto import backend as cb
+        pubs = np.concatenate([s.arrays[0] for s in subs])
+        msgs = np.concatenate([s.arrays[1] for s in subs])
+        sigs = np.concatenate([s.arrays[2] for s in subs])
+        return cb.verify_batch(pubs, msgs, sigs)
+
+    @staticmethod
+    def _run_secp(subs) -> np.ndarray:
+        from tendermint_tpu.crypto import secp256k1
+        out = []
+        for s in subs:
+            for pub, msg, sig in s.arrays[0]:
+                out.append(
+                    secp256k1.PubKeySecp256k1(pub).verify(msg, sig))
+        return np.asarray(out, dtype=bool)
+
+    # -- lifecycle / introspection --------------------------------------
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Block until the queue AND in-flight work are empty (tests,
+        clean shutdown).  True when drained, False on timeout."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while self._pending or self._inflight:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._cond.notify_all()
+                self._cond.wait(min(left, 0.05))
+        return True
+
+    def stop(self) -> None:
+        with self._cond:
+            self._stopped = True
+            self._cond.notify_all()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=5.0)
+
+    def depth(self) -> int:
+        with self._cond:
+            return sum(len(b.subs) for b in self._pending.values())
+
+
+def _chunk(n: int) -> int:
+    """The padded chunk size `n` lanes will ride (the backend's
+    power-of-2 bucket) — the denominator of plane-level occupancy."""
+    from tendermint_tpu.crypto.backend import _bucket
+    return _bucket(n)
+
+
+# -- process-wide singleton --------------------------------------------------
+
+_PLANE: BatchPlane | None = None
+_PLANE_LOCK = threading.Lock()
+
+
+def get_plane() -> BatchPlane:
+    global _PLANE
+    with _PLANE_LOCK:
+        if _PLANE is None:
+            _PLANE = BatchPlane()
+        return _PLANE
+
+
+def reset_plane() -> None:
+    """Stop and discard the singleton (tests; chaos rigs between runs)."""
+    global _PLANE
+    with _PLANE_LOCK:
+        if _PLANE is not None:
+            _PLANE.stop()
+            _PLANE = None
+
+
+# -- synchronous producer wrappers ------------------------------------------
+#
+# Drop-in equivalents of the crypto.backend module helpers, routed
+# through the plane.  Producers call THESE; tmlint's `batchplane` rule
+# flags direct backend calls from consensus/light/mempool/blockchain.
+
+
+def verify_grouped(set_key: bytes, val_pubs, val_idx, msgs, sigs, *,
+                   producer: str, klass: str,
+                   max_wait: float | None = None) -> np.ndarray:
+    return get_plane().submit_grouped(
+        set_key, val_pubs, val_idx, msgs, sigs, producer=producer,
+        klass=klass, max_wait=max_wait).wait()
+
+
+def verify_grouped_templated(set_key: bytes, val_pubs, val_idx, tmpl_idx,
+                             templates, sigs, *, producer: str,
+                             klass: str,
+                             max_wait: float | None = None) -> np.ndarray:
+    return get_plane().submit_templated(
+        set_key, val_pubs, val_idx, tmpl_idx, templates, sigs,
+        producer=producer, klass=klass, max_wait=max_wait).wait()
+
+
+def verify_batch(pubkeys, msgs, sigs, *, producer: str, klass: str,
+                 max_wait: float | None = None) -> np.ndarray:
+    return get_plane().submit_raw(
+        pubkeys, msgs, sigs, producer=producer, klass=klass,
+        max_wait=max_wait).wait()
+
+
+def verify_secp(items: list[tuple[bytes, bytes, bytes]], *, producer: str,
+                klass: str, max_wait: float | None = None) -> np.ndarray:
+    return get_plane().submit_secp(
+        items, producer=producer, klass=klass, max_wait=max_wait).wait()

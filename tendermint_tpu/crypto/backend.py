@@ -196,10 +196,14 @@ class TpuBackend:
         # hosts skip the sharding machinery entirely.
         self._mesh = None
         self._sharded_fns: dict[bytes, object] = {}
+        self._base_tbl_mesh = None
         n_dev = len(jax.devices())
         if n_dev > 1:
             from tendermint_tpu.parallel import sharding
+            from jax.sharding import NamedSharding, PartitionSpec
             self._mesh = sharding.make_mesh(n_dev)
+            self._base_tbl_mesh = jax.device_put(
+                self._base_tbl, NamedSharding(self._mesh, PartitionSpec()))
         metrics.set_build_info(jax_backend=jax.default_backend(),
                                local_devices=n_dev)
 
@@ -768,7 +772,7 @@ class TpuBackend:
             if on_mesh:
                 fn = self._sharded_fn(tbl.shape[2], msgs.shape[-1])
                 out = fn(tbl, pub_ok, val_idx.astype(np.int32), pubkeys,
-                         msgs, sigs)
+                         msgs, sigs, self._base_tbl_mesh)
             else:
                 out = self._dev.verify_grouped_jit(
                     tbl, pub_ok, jnp.asarray(val_idx.astype(np.int32)),

@@ -69,11 +69,13 @@ def verify_commit_any(old_set: ValidatorSet, new_set: ValidatorSet,
     Implements what the reference stubs at
     `types/validator_set.go:268-290`.
     """
-    from tendermint_tpu.crypto import backend as cb
+    from tendermint_tpu import batchplane
     _, msgs, sigs, new_powers, idxs = new_set.commit_verify_arrays(
         chain_id, block_id, height, commit)
-    ok = cb.verify_grouped(new_set.set_key(), new_set.pubs_matrix(),
-                           idxs, msgs, sigs)
+    ok = batchplane.verify_grouped(new_set.set_key(),
+                                   new_set.pubs_matrix(), idxs, msgs, sigs,
+                                   producer="light",
+                                   klass=batchplane.CLASS_LIGHT)
     if not ok.all():
         raise CommitSignatureError(height, int(np.argmin(ok)))
     new_tallied = int(new_powers.sum())
@@ -145,8 +147,11 @@ class LightClient:
         for attempt in (0, 1):
             try:
                 if trusted_set.hash() == validators.hash():
+                    from tendermint_tpu import batchplane
                     validators.verify_commit(self.chain_id, block_id,
-                                             h.height, sh.commit)
+                                             h.height, sh.commit,
+                                             producer="light",
+                                             klass=batchplane.CLASS_LIGHT)
                 else:
                     verify_commit_any(trusted_set, validators,
                                       self.chain_id, block_id, h.height,
@@ -183,10 +188,13 @@ def verify_chains_batched(chains: list[ChainBatch]) -> None:
     chains pays table build once per (chain, valset) epoch.  Raises on the
     first failing chain (error names chain and height).
     """
+    from tendermint_tpu import batchplane
     from tendermint_tpu.types.validator import verify_commits_batched
     for cb_ in chains:
         try:
-            verify_commits_batched(cb_.validators, cb_.chain_id, cb_.items)
+            verify_commits_batched(cb_.validators, cb_.chain_id, cb_.items,
+                                   producer="light",
+                                   klass=batchplane.CLASS_LIGHT)
         except (CommitSignatureError, CommitPowerError) as e:
             log.warn("light verification failed", chain=cb_.chain_id,
                      height=e.height)

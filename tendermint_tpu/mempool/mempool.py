@@ -13,11 +13,61 @@ removal on update, safe concurrent CheckTx from RPC threads.
 
 from __future__ import annotations
 
+import hashlib
 import os
 from collections import OrderedDict
 
+from tendermint_tpu.abci.types import ERR_BAD_SIG, ERR_ENCODING, Result
 from tendermint_tpu.types.tx import Tx
 from tendermint_tpu.utils import lockwitness
+from tendermint_tpu.utils.chaos import DeviceFault
+
+# -- signed-tx envelope ----------------------------------------------------
+# Optional authenticated tx framing: a tagged prefix carries the sender's
+# key and a signature over sha256(payload), so the pool can reject forged
+# submissions BEFORE the app sees them — on the device batch plane, where
+# concurrent RPC CheckTx lanes coalesce into one verify batch.  The
+# signature covers the payload DIGEST (fixed 32-byte message) so every
+# lane shares one compiled shape regardless of payload size.  Unprefixed
+# txs skip the check entirely (the app's own CheckTx still runs).
+TAG_ED25519 = 0xE1      # [tag][pub 32][sig 64][payload...]
+TAG_SECP256K1 = 0xE2    # [tag][pub 33][siglen 1][sig][payload...]
+
+
+def sign_tx_ed25519(seed: bytes, payload: bytes) -> bytes:
+    """Wrap payload in the ed25519 envelope (test/fixture helper)."""
+    from tendermint_tpu.types.keys import PrivKey
+    priv = PrivKey(seed)
+    digest = hashlib.sha256(payload).digest()
+    return (bytes([TAG_ED25519]) + priv.pub_key.bytes_ +
+            priv.sign(digest) + payload)
+
+
+def sign_tx_secp256k1(priv, payload: bytes) -> bytes:
+    """Wrap payload in the secp256k1 envelope (`PrivKeySecp256k1`)."""
+    digest = hashlib.sha256(payload).digest()
+    sig = priv.sign(digest)
+    return (bytes([TAG_SECP256K1]) + priv.pub_key.bytes_ +
+            bytes([len(sig)]) + sig + payload)
+
+
+def parse_signed_tx(tx: bytes):
+    """(scheme, pub, sig, payload) for enveloped txs, None for unsigned.
+
+    Raises ValueError on a malformed envelope: a tx claiming a signature
+    scheme must never fall through as unsigned."""
+    if not tx or tx[0] not in (TAG_ED25519, TAG_SECP256K1):
+        return None
+    if tx[0] == TAG_ED25519:
+        if len(tx) < 1 + 32 + 64 + 1:
+            raise ValueError("ed25519 envelope truncated")
+        return ("ed25519", tx[1:33], tx[33:97], tx[97:])
+    if len(tx) < 1 + 33 + 1 + 1:
+        raise ValueError("secp256k1 envelope truncated")
+    siglen = tx[34]
+    if siglen == 0 or len(tx) < 1 + 33 + 1 + siglen + 1:
+        raise ValueError("secp256k1 envelope truncated")
+    return ("secp256k1", tx[1:34], tx[35:35 + siglen], tx[35 + siglen:])
 
 
 class Mempool:
@@ -72,7 +122,10 @@ class Mempool:
         The app call happens UNDER the mempool lock: consensus holds this
         lock across app Commit + update (reference proxyMtx semantics), so
         no tx can validate against a half-committed app and then slip into
-        the pool after the recheck pass.
+        the pool after the recheck pass.  The signed-envelope verify runs
+        OUTSIDE the lock (it is app-state independent) so concurrent RPC
+        CheckTx lanes coalesce on the device batch plane instead of
+        serializing a device round-trip each behind the pool lock.
         """
         h = Tx(tx).hash
         with self._lock:
@@ -81,6 +134,13 @@ class Mempool:
             self._cache[h] = None
             while len(self._cache) > self._cache_size:
                 self._cache.popitem(last=False)
+        rej = self._verify_signed(tx)
+        if rej is not None:
+            with self._lock:
+                # bad signature: allow future resubmission of a fixed tx
+                self._cache.pop(h, None)
+            return rej
+        with self._lock:
             res = self.proxy.check_tx(tx)
             if res.is_ok:
                 if self._wal is not None and not self._recovering:
@@ -97,6 +157,47 @@ class Mempool:
                 # invalid tx: allow future resubmission (reference :259-264)
                 self._cache.pop(h, None)
         return res
+
+    def _verify_signed(self, tx: bytes):
+        """Envelope signature gate: None when tx may proceed to the app,
+        else the rejecting `Result`.  ed25519 lanes ride the batch plane
+        (mempool class — preempted by consensus votes); a `DeviceFault`
+        that survives the supervised ladder falls back to the scalar
+        verifier rather than rejecting a possibly-valid tx."""
+        try:
+            parsed = parse_signed_tx(tx)
+        except ValueError as e:
+            return Result(code=ERR_ENCODING,
+                          log=f"bad signed-tx envelope: {e}")
+        if parsed is None:
+            return None
+        scheme, pub, sig, payload = parsed
+        digest = hashlib.sha256(payload).digest()
+        from tendermint_tpu import batchplane
+        if scheme == "secp256k1":
+            from tendermint_tpu.crypto import secp256k1
+            if not secp256k1.AVAILABLE:
+                return Result(code=ERR_ENCODING,
+                              log="secp256k1 support unavailable")
+            ok = bool(batchplane.verify_secp(
+                [(pub, digest, sig)], producer="mempool",
+                klass=batchplane.CLASS_MEMPOOL)[0])
+        else:
+            import numpy as np
+            try:
+                ok = bool(batchplane.verify_batch(
+                    np.frombuffer(pub, np.uint8).reshape(1, 32),
+                    np.frombuffer(digest, np.uint8).reshape(1, 32),
+                    np.frombuffer(sig, np.uint8).reshape(1, 64),
+                    producer="mempool",
+                    klass=batchplane.CLASS_MEMPOOL)[0])
+            except DeviceFault:
+                from tendermint_tpu.types.keys import _verify_memo
+                ok = _verify_memo(pub, digest, sig)
+        if not ok:
+            return Result(code=ERR_BAD_SIG,
+                          log=f"invalid {scheme} tx signature")
+        return None
 
     def _notify_available(self):
         if (self._txs_available_cb is not None and

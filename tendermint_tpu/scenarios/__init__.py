@@ -21,11 +21,12 @@ from tendermint_tpu.scenarios.engine import (CHAOS_RUN_SCHEMA,
 from tendermint_tpu.scenarios import catalog  # registers the shipped set
 from tendermint_tpu.scenarios import live    # registers the big-rig tier
 from tendermint_tpu.scenarios import statesync_scenarios  # snapshot tier
+from tendermint_tpu.scenarios import batchplane_scenarios  # verify plane
 from tendermint_tpu.scenarios.catalog import SMOKE_ORDER
 
 __all__ = ["CHAOS_RUN_SCHEMA", "DEFAULT_CHAOS_LEDGER", "DEFAULT_SEED",
            "KNOWN_BACKENDS", "SCENARIOS", "SMOKE_ORDER",
            "InvariantViolation", "ScenarioResult", "artifacts_root",
-           "catalog", "live", "parse_seed_range", "register",
-           "resolve_backend", "run_scenario", "run_sweep",
-           "statesync_scenarios"]
+           "batchplane_scenarios", "catalog", "live",
+           "parse_seed_range", "register", "resolve_backend",
+           "run_scenario", "run_sweep", "statesync_scenarios"]

@@ -1337,4 +1337,4 @@ register(
 
 SMOKE_ORDER = ["device-wrong-answer", "evidence-flood",
                "byz-equivocation", "device-rung-walk",
-               "snapshot-torn-tail"]
+               "snapshot-torn-tail", "batchplane-isolation"]

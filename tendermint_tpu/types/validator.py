@@ -464,16 +464,19 @@ class ValidatorSet:
                 powers.astype(np.int64), idxs, foreign_power)
 
     def verify_commit(self, chain_id: str, block_id, height: int,
-                      commit) -> None:
+                      commit, producer: str = "fastsync",
+                      klass: str | None = None) -> None:
         """Raise unless +2/3 of this set signed block_id at height
         (reference `types/validator_set.go:220-264`); signatures checked in
-        one crypto-backend batch against this set's cached comb tables."""
-        from tendermint_tpu.crypto import backend as cb
+        one batch-plane submission against this set's cached comb tables
+        (`producer`/`klass` name the workload for scheduling + metrics)."""
+        from tendermint_tpu import batchplane
         templates, tmpl_idx, sigs, powers, idxs, foreign_power = \
             self.commit_verify_lanes(chain_id, block_id, height, commit)
-        ok = cb.verify_grouped_templated(
+        ok = batchplane.verify_grouped_templated(
             self.set_key(), self.pubs_matrix(), idxs, tmpl_idx,
-            templates, sigs)
+            templates, sigs, producer=producer,
+            klass=klass or batchplane.CLASS_FASTSYNC)
         if not ok.all():
             raise CommitSignatureError(height, int(np.argmin(ok)))
         tallied = int(powers.sum())
@@ -624,7 +627,9 @@ def window_tally_check(items: list[tuple], ok: np.ndarray,
 
 
 def verify_commits_batched(val_set: ValidatorSet, chain_id: str,
-                           items: list[tuple]) -> None:
+                           items: list[tuple],
+                           producer: str = "fastsync",
+                           klass: str | None = None) -> None:
     """Verify MANY commits against one validator set in a single device
     call — the fast-sync window (`items` = [(block_id, height, commit)]).
 
@@ -637,14 +642,15 @@ def verify_commits_batched(val_set: ValidatorSet, chain_id: str,
     the host never loops per block on the hot path.  Raises ValueError
     naming the first failing height.
     """
-    from tendermint_tpu.crypto import backend as cb
+    from tendermint_tpu import batchplane
     if not items:
         return
     templates, tmpl_idx, sigs, idxs, counts, tallied, foreign = \
         window_commit_lanes(val_set, chain_id, items)
-    ok = cb.verify_grouped_templated(val_set.set_key(),
-                                     val_set.pubs_matrix(), idxs,
-                                     tmpl_idx, templates, sigs)
+    ok = batchplane.verify_grouped_templated(
+        val_set.set_key(), val_set.pubs_matrix(), idxs,
+        tmpl_idx, templates, sigs, producer=producer,
+        klass=klass or batchplane.CLASS_FASTSYNC)
     window_tally_check(items, ok, counts, tallied, foreign,
                        val_set.total_voting_power())
 

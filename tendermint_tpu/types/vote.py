@@ -131,8 +131,13 @@ def batch_verify_vote_sigs(chain_id: str, val_set, votes) -> np.ndarray:
     the fixed 32-byte rows `batch_sign_bytes` documents (validate_basic
     pinned all hash lengths, so the padding matches the scalar writer).
     Returns bool[N].
+
+    Lanes ride the unified batch plane at the CONSENSUS class — the
+    highest priority: a vote burst preempts any queued light-client or
+    CheckTx batch, and the plane may coalesce it with other verify work
+    for this validator set already in flight.
     """
-    from tendermint_tpu.crypto import backend as cb
+    from tendermint_tpu import batchplane
     n = len(votes)
     if n == 0:
         return np.zeros(0, dtype=bool)
@@ -147,12 +152,13 @@ def batch_verify_vote_sigs(chain_id: str, val_set, votes) -> np.ndarray:
                                for v in votes), np.uint8).reshape(n, 32),
         np.asarray([v.block_id.parts.total for v in votes],
                    dtype=np.uint32))
-    return cb.verify_grouped(
+    return batchplane.verify_grouped(
         val_set.set_key(), val_set.pubs_matrix(),
         np.asarray([v.validator_index for v in votes], dtype=np.int32),
         msgs,
         np.frombuffer(b"".join(v.signature for v in votes),
-                      np.uint8).reshape(n, 64))
+                      np.uint8).reshape(n, 64),
+        producer="consensus", klass=batchplane.CLASS_CONSENSUS)
 
 
 class VoteSet:

@@ -271,8 +271,34 @@ def observe_window_metrics(attr: dict) -> None:
 _THIEVES = ("compile", "device_idle", "transfer", "scalar_tail")
 
 
+def batchplane_summary(metrics: dict) -> dict | None:
+    """Batch-plane coalescing health from a `REGISTRY.snapshot()` dict:
+    how full the flushed chunks ran, who filled them, and why they
+    shipped.  None when the plane never flushed (nothing to say).
+
+    `half_full_stolen_seconds` is added by `doctor_report`: device-busy
+    time estimated wasted on padding lanes, device_busy * (1 - mean
+    occupancy) — the padded tail of a chunk costs the same device time
+    as the real lanes, so a plane flushing half-full burns about half
+    its device-busy seconds verifying zeros."""
+    occ = metrics.get("batchplane_occupancy") or {}
+    flushes = metrics.get("batchplane_flushes") or 0
+    if not flushes or not occ.get("count"):
+        return None
+    return {
+        "flushes": flushes,
+        "mixed_batches": metrics.get("batchplane_mixed_batches", 0),
+        "occupancy_mean": round(occ["sum"] / occ["count"], 4),
+        "occupancy_p50": occ.get("p50"),
+        "flush_reason": dict(metrics.get("batchplane_flush_reason") or {}),
+        "lanes_by_producer": dict(metrics.get("batchplane_lanes") or {}),
+        "wait_seconds": metrics.get("batchplane_wait_seconds") or {},
+    }
+
+
 def doctor_report(spans, key: str = "window",
-                  regressions: dict | None = None) -> dict:
+                  regressions: dict | None = None,
+                  metrics: dict | None = None) -> dict:
     """Machine-readable attribution report over a span dump.
 
     `headline_gap` sums the partition across all windows (falling back
@@ -281,7 +307,10 @@ def doctor_report(spans, key: str = "window",
     the first thing to fix on the road back to the 20x target.
     `regressions` (from utils/ledger.py) is folded in verbatim so one
     document answers both "where did the time go" and "did we get
-    slower"."""
+    slower".  `metrics` (a `REGISTRY.snapshot()` dict) adds the batch
+    plane's coalescing health and lets half-full batches compete as a
+    named thief — padding lanes burn device-busy time the partition
+    alone would misread as productive."""
     windows = window_attribution(spans, key)
     cat_ivs = spans_by_category(spans)
     if windows:
@@ -307,17 +336,30 @@ def doctor_report(spans, key: str = "window",
                                     "device_idle")}
             overlap = 0.0
     gap = {k: round(v, 4) for k, v in gap.items()}
-    thief = max(_THIEVES, key=lambda k: gap.get(k, 0.0))
+    thief_pool = {k: gap.get(k, 0.0) for k in _THIEVES}
+    plane = batchplane_summary(metrics) if metrics else None
+    if plane is not None:
+        # half-full batches steal from INSIDE device_busy: the padded
+        # chunk tail costs real device time, so it races the partition
+        # components as its own thief rather than adding to the sum
+        plane["half_full_stolen_seconds"] = round(
+            gap.get("device_busy", 0.0) * (1.0 - plane["occupancy_mean"]),
+            4)
+        thief_pool["half_full_batches"] = plane["half_full_stolen_seconds"]
+    thief = max(thief_pool, key=lambda k: thief_pool[k])
     report = {
         "schema": DOCTOR_SCHEMA,
         "span_count": len(spans),
         "window_count": len(windows),
         "headline_gap": gap,
         "overlap_fraction": round(overlap, 4),
-        "largest_thief": (thief if gap.get(thief, 0.0) > 0 else None),
+        "largest_thief": (thief if thief_pool.get(thief, 0.0) > 0
+                          else None),
         "windows": [{k: (round(v, 4) if isinstance(v, float) else v)
                      for k, v in w.items()} for w in windows],
     }
+    if plane is not None:
+        report["batchplane"] = plane
     if regressions is not None:
         report["regressions"] = regressions
     return report
@@ -327,13 +369,16 @@ def render_report(report: dict) -> str:
     """Human summary of a doctor report — one paragraph an operator can
     read off a terminal, naming the largest thief first."""
     gap = report["headline_gap"]
+    plane = report.get("batchplane") or {}
     wall = gap.get("wall") or 0.0
     lines = []
     thief = report.get("largest_thief")
     if thief and wall > 0:
-        pct = 100.0 * gap[thief] / wall
+        stolen = (plane.get("half_full_stolen_seconds", 0.0)
+                  if thief == "half_full_batches" else gap[thief])
+        pct = 100.0 * stolen / wall
         lines.append(
-            f"largest thief: {thief} ({gap[thief]:.1f}s, {pct:.0f}% of "
+            f"largest thief: {thief} ({stolen:.1f}s, {pct:.0f}% of "
             f"{wall:.1f}s window wall clock)")
     elif wall > 0:
         lines.append(f"no attributable gap found in {wall:.1f}s of "
@@ -349,6 +394,16 @@ def render_report(report: dict) -> str:
         lines.append(f"pipeline overlap fraction: "
                      f"{report['overlap_fraction']:.2f} over "
                      f"{report['window_count']} window(s)")
+    if plane:
+        reasons = ", ".join(f"{k}={v}" for k, v in
+                            sorted(plane["flush_reason"].items()))
+        lines.append(
+            f"batch plane: {plane['flushes']} flushes "
+            f"({plane['mixed_batches']} mixed-producer), occupancy "
+            f"mean {plane['occupancy_mean']:.2f}, ~"
+            f"{plane.get('half_full_stolen_seconds', 0.0):.1f}s burned "
+            f"on padding lanes"
+            + (f" [{reasons}]" if reasons else ""))
     regs = report.get("regressions") or {}
     flagged = {k: v for k, v in regs.items()
                if isinstance(v, dict) and v.get("regression")}

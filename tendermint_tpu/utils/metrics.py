@@ -227,6 +227,35 @@ class GaugeVec:
             return [(k, g.value) for k, g in sorted(self._cells.items())]
 
 
+class HistogramVec:
+    """A histogram family keyed by one label — per-class batch-plane
+    queue-wait distributions (`vec.labels("consensus").observe(dt)`)
+    without pre-declaring the class list.  Renders as one labeled
+    _bucket/_sum/_count triple per cell."""
+
+    __slots__ = ("label", "bounds", "_cells", "_lock")
+
+    def __init__(self, label: str, bounds=Histogram.LATENCY_BOUNDS):
+        self.label = label
+        self.bounds = tuple(bounds)
+        self._cells: dict[str, Histogram] = {}
+        self._lock = threading.Lock()
+
+    def labels(self, value: str) -> Histogram:
+        with self._lock:
+            h = self._cells.get(value)
+            if h is None:
+                h = self._cells[value] = Histogram(self.bounds)
+            return h
+
+    def items(self) -> list[tuple[str, Histogram]]:
+        with self._lock:
+            return sorted(self._cells.items())
+
+    def snapshot(self) -> dict:
+        return {k: h.snapshot() for k, h in self.items()}
+
+
 class Registry:
     def __init__(self):
         self._start = time.time()
@@ -313,6 +342,20 @@ class Registry:
         # delta_frac of the latest run vs best prior (negative = slower);
         # alert on < -threshold
         self.bench_regression = Gauge()
+        # unified batch plane (batchplane/scheduler.py): the coalescing
+        # proof lives here — occupancy is real lanes over the padded
+        # chunk a flush rode, mixed_batches counts flushes whose lanes
+        # came from >1 producer, and the per-class wait histogram is
+        # the latency cost each class paid to coalesce
+        self.batchplane_flushes = Counter()
+        self.batchplane_mixed_batches = Counter()
+        self.batchplane_flush_reason = CounterVec("reason")
+        self.batchplane_lanes = CounterVec("producer")
+        self.batchplane_occupancy_hist = Histogram(Histogram.RATIO_BOUNDS)
+        self.batchplane_queue_depth_hist = Histogram(
+            (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024))
+        self.batchplane_wait_seconds = HistogramVec(
+            "klass", Histogram.LATENCY_BOUNDS)
 
     def snapshot(self) -> dict:
         up = max(time.time() - self._start, 1e-9)
@@ -371,6 +414,18 @@ class Registry:
             "d2h_bytes": self.d2h_bytes.value,
             "device_util": dict(self.device_util.items()),
             "bench_regression": self.bench_regression.value,
+            "batchplane_flushes": self.batchplane_flushes.value,
+            "batchplane_mixed_batches":
+                self.batchplane_mixed_batches.value,
+            "batchplane_flush_reason":
+                dict(self.batchplane_flush_reason.items()),
+            "batchplane_lanes": dict(self.batchplane_lanes.items()),
+            "batchplane_occupancy":
+                self.batchplane_occupancy_hist.snapshot(),
+            "batchplane_queue_depth":
+                self.batchplane_queue_depth_hist.snapshot(),
+            "batchplane_wait_seconds":
+                self.batchplane_wait_seconds.snapshot(),
         }
 
 
@@ -468,6 +523,19 @@ def prometheus_text(registry: Registry | None = None) -> str:
                 lines.append(
                     f"{name}{{{inst.label}=\"{_prom_escape(label_value)}\"}}"
                     f" {_prom_f(v)}")
+        elif isinstance(inst, HistogramVec):
+            lines.append(f"# TYPE {name} histogram")
+            for label_value, h in inst.items():
+                lv = _prom_escape(label_value)
+                for le, cum in h.buckets():
+                    lines.append(
+                        f"{name}_bucket{{{inst.label}=\"{lv}\","
+                        f"le=\"{_prom_f(le)}\"}} {cum}")
+                lines.append(
+                    f"{name}_sum{{{inst.label}=\"{lv}\"}} "
+                    f"{_prom_f(h.sum)}")
+                lines.append(
+                    f"{name}_count{{{inst.label}=\"{lv}\"}} {h.count}")
     lines.append(f"# TYPE {_PROM_PREFIX}uptime_seconds gauge")
     lines.append(f"{_PROM_PREFIX}uptime_seconds "
                  f"{_prom_f(round(time.time() - r._start, 3))}")

@@ -215,6 +215,54 @@ def test_render_report_names_largest_thief():
     assert "overlap fraction" in text
 
 
+def _plane_metrics(occ_mean, flushes=10, mixed=4):
+    """REGISTRY.snapshot()-shaped batch-plane slice at a given mean
+    flush occupancy."""
+    return {
+        "batchplane_flushes": flushes,
+        "batchplane_mixed_batches": mixed,
+        "batchplane_occupancy": {"count": flushes,
+                                 "sum": occ_mean * flushes,
+                                 "p50": occ_mean},
+        "batchplane_flush_reason": {"deadline": 6, "full": 4},
+        "batchplane_lanes": {"consensus": 64, "light": 32},
+        "batchplane_wait_seconds": {},
+    }
+
+
+def test_doctor_half_full_batches_named_thief():
+    spans = [
+        _span("bench.prep", 0, 0.5, window=0),
+        _span("verify.batch", 0.5, 9, window=0),
+        _span("bench.apply", 9.5, 0.5, window=0),
+    ]
+    rep = at.doctor_report(spans, metrics=_plane_metrics(0.25))
+    plane = rep["batchplane"]
+    assert plane["flushes"] == 10 and plane["mixed_batches"] == 4
+    # ~9s device_busy at 25% occupancy -> ~6.75s burned verifying
+    # padding lanes, larger than every partition component
+    assert plane["half_full_stolen_seconds"] > 6
+    assert rep["largest_thief"] == "half_full_batches"
+    text = at.render_report(rep)
+    assert text.startswith("largest thief: half_full_batches")
+    assert "batch plane: 10 flushes (4 mixed-producer)" in text
+    json.dumps(rep)
+
+
+def test_doctor_full_batches_do_not_steal():
+    spans = [_span("verify.batch", 0, 9, window=0),
+             _span("scalar.verify", 9, 1, window=0)]
+    rep = at.doctor_report(spans, metrics=_plane_metrics(1.0))
+    assert rep["batchplane"]["half_full_stolen_seconds"] == 0
+    assert rep["largest_thief"] != "half_full_batches"
+
+
+def test_doctor_quiet_plane_reports_no_section():
+    rep = at.doctor_report([], metrics={"batchplane_flushes": 0})
+    assert "batchplane" not in rep
+    assert at.batchplane_summary({}) is None
+
+
 # -- chrome round trip -------------------------------------------------------
 
 def test_spans_from_chrome_round_trip():

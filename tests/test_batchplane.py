@@ -1,0 +1,380 @@
+"""Batch-plane scheduler semantics: coalescing, priority preemption,
+per-producer fairness, deadline-vs-full flushing, chunk-shape reuse, and
+DeviceFault isolation — plus the mempool signed-tx envelope lane.
+
+Most tests stub the `crypto.backend` module helpers (the plane calls
+them at flush time, so a monkeypatched function is what the worker
+executes): scheduling semantics are host-side and must not cost a device
+compile.  The chunk-shape test uses the real TpuBackend and the shadow
+jit-cache counters.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from tendermint_tpu import batchplane
+from tendermint_tpu.batchplane.scheduler import (BatchPlane, Submission,
+                                                 _PendingBatch)
+from tendermint_tpu.utils.chaos import DeviceFault
+from tendermint_tpu.utils.metrics import REGISTRY
+
+SET_KEY = b"plane-set"
+V, MSG_LEN = 4, 96
+
+
+def _mk_grouped(n, msg_len=MSG_LEN):
+    vp = np.zeros((V, 32), np.uint8)
+    idx = (np.arange(n) % V).astype(np.int32)
+    msgs = np.zeros((n, msg_len), np.uint8)
+    sigs = np.zeros((n, 64), np.uint8)
+    return vp, idx, msgs, sigs
+
+
+def _stub_grouped(monkeypatch, calls, result=None):
+    """Replace the backend grouped helper with a recorder."""
+    import tendermint_tpu.crypto.backend as cb
+
+    def fake(set_key, val_pubs, val_idx, msgs, sigs):
+        calls.append(len(val_idx))
+        if result is not None:
+            return result(len(val_idx))
+        return np.ones(len(val_idx), dtype=bool)
+
+    monkeypatch.setattr(cb, "verify_grouped", fake)
+
+
+@pytest.fixture
+def plane():
+    p = BatchPlane(target_lanes=8, max_flush_lanes=64)
+    yield p
+    p.stop()
+
+
+# -- coalescing ------------------------------------------------------------
+
+
+def test_cross_producer_coalescing_one_flush(plane, monkeypatch):
+    """Two producers' grouped lanes on the same set merge into ONE
+    backend call, each getting exactly its slice back."""
+    calls = []
+    _stub_grouped(monkeypatch, calls,
+                  result=lambda n: np.arange(n) % 2 == 0)
+    vp, idx, msgs, sigs = _mk_grouped(8)
+    mixed0 = REGISTRY.batchplane_mixed_batches.value
+    s1 = plane.submit_grouped(SET_KEY, vp, idx[:3], msgs[:3], sigs[:3],
+                              producer="consensus", klass="consensus",
+                              max_wait=10.0)
+    s2 = plane.submit_grouped(SET_KEY, vp, idx[3:], msgs[3:], sigs[3:],
+                              producer="light", klass="light",
+                              max_wait=10.0)
+    r1, r2 = s1.wait(), s2.wait()
+    assert calls == [8]            # one coalesced flush, full at target
+    assert r1.tolist() == [True, False, True]
+    assert r2.tolist() == [False, True, False, True, False]
+    assert REGISTRY.batchplane_mixed_batches.value == mixed0 + 1
+
+
+def test_deadline_flush_beats_batch_full(plane, monkeypatch):
+    """A half-full batch ships when its oldest deadline arrives — it
+    never waits for the lanes that would make it full."""
+    calls = []
+    _stub_grouped(monkeypatch, calls)
+    vp, idx, msgs, sigs = _mk_grouped(2)
+    before = REGISTRY.batchplane_flush_reason.labels("deadline").value
+    t0 = time.perf_counter()
+    sub = plane.submit_grouped(SET_KEY, vp, idx, msgs, sigs,
+                               producer="fastsync", klass="fastsync",
+                               max_wait=0.05)
+    out = sub.wait()
+    waited = time.perf_counter() - t0
+    assert out.all() and calls == [2]
+    assert waited < 5.0            # deadline fired, not a 1024-lane wait
+    assert REGISTRY.batchplane_flush_reason.labels(
+        "deadline").value == before + 1
+
+
+def test_full_batch_ships_without_deadline(plane, monkeypatch):
+    calls = []
+    _stub_grouped(monkeypatch, calls)
+    vp, idx, msgs, sigs = _mk_grouped(8)
+    before = REGISTRY.batchplane_flush_reason.labels("full").value
+    sub = plane.submit_grouped(SET_KEY, vp, idx, msgs, sigs,
+                               producer="light", klass="light",
+                               max_wait=30.0)
+    sub.wait()
+    assert calls == [8]
+    assert REGISTRY.batchplane_flush_reason.labels(
+        "full").value == before + 1
+
+
+# -- priority & fairness ---------------------------------------------------
+
+
+def _sub(producer, klass, n=1, deadline=0.0):
+    s = Submission("grouped", ("grouped", SET_KEY, MSG_LEN), producer,
+                   klass, deadline, (None,), n)
+    return s
+
+
+def test_priority_consensus_preempts_light():
+    """With a light batch AND a consensus batch both ready, the
+    consensus batch ships first even though light queued earlier."""
+    p = BatchPlane(target_lanes=4, max_flush_lanes=64)
+    light = _PendingBatch(("grouped", b"light-set", MSG_LEN))
+    for _ in range(4):
+        light.add(_sub("light", "light"))
+    cons = _PendingBatch(("grouped", b"cons-set", MSG_LEN))
+    for _ in range(4):
+        cons.add(_sub("consensus", "consensus"))
+    with p._cond:
+        p._pending[light.key] = light     # light queued FIRST
+        p._pending[cons.key] = cons
+        batch, reason = p._next_flush_locked()
+    assert reason == "full"
+    assert batch is cons
+
+
+def test_priority_applies_to_deadline_flushes_too():
+    p = BatchPlane(target_lanes=1024, max_flush_lanes=64)
+    past = time.perf_counter() - 1.0
+    light = _PendingBatch(("grouped", b"light-set", MSG_LEN))
+    light.add(_sub("light", "light", deadline=past - 0.5))  # MORE overdue
+    cons = _PendingBatch(("grouped", b"cons-set", MSG_LEN))
+    cons.add(_sub("consensus", "consensus", deadline=past))
+    with p._cond:
+        p._pending[light.key] = light
+        p._pending[cons.key] = cons
+        batch, reason = p._next_flush_locked()
+    assert reason == "deadline"
+    assert batch is cons
+
+
+def test_fairness_flood_cannot_starve_minority():
+    """Truncated flushes take lanes round-robin per producer: a flooding
+    producer gets at most its share, the minority producer always
+    lands lanes in the flush."""
+    p = BatchPlane(target_lanes=8, max_flush_lanes=8)
+    batch = _PendingBatch(("grouped", SET_KEY, MSG_LEN))
+    for _ in range(50):
+        batch.add(_sub("flood", "light"))
+    for _ in range(4):
+        batch.add(_sub("minority", "consensus"))
+    with p._cond:
+        p._pending[batch.key] = batch
+        taken = p._take_locked(batch)
+        leftover = p._pending[batch.key]
+    by = {}
+    for s in taken:
+        by[s.producer] = by.get(s.producer, 0) + s.n
+    assert sum(by.values()) == 8
+    assert by["minority"] == 4          # every minority lane shipped
+    assert by["flood"] == 4             # flood capped at the remainder
+    assert leftover.lanes == 46         # leftovers requeued, not dropped
+
+
+# -- fault isolation -------------------------------------------------------
+
+
+def test_devicefault_blames_only_the_flushed_submissions(plane,
+                                                         monkeypatch):
+    import tendermint_tpu.crypto.backend as cb
+    boom = {"on": True}
+
+    def fake(set_key, val_pubs, val_idx, msgs, sigs):
+        if boom["on"]:
+            raise DeviceFault("chaos: injected verify fault")
+        return np.ones(len(val_idx), dtype=bool)
+
+    monkeypatch.setattr(cb, "verify_grouped", fake)
+    vp, idx, msgs, sigs = _mk_grouped(8)
+    s1 = plane.submit_grouped(SET_KEY, vp, idx[:3], msgs[:3], sigs[:3],
+                              producer="consensus", klass="consensus",
+                              max_wait=10.0)
+    s2 = plane.submit_grouped(SET_KEY, vp, idx[3:], msgs[3:], sigs[3:],
+                              producer="light", klass="light",
+                              max_wait=10.0)
+    with pytest.raises(DeviceFault):
+        s1.wait()
+    with pytest.raises(DeviceFault):
+        s2.wait()
+    # the PLANE survives: later flushes proceed once the device heals
+    boom["on"] = False
+    s3 = plane.submit_grouped(SET_KEY, vp, idx, msgs, sigs,
+                              producer="fastsync", klass="fastsync",
+                              max_wait=0.05)
+    assert s3.wait().all()
+
+
+# -- chunk-shape reuse -----------------------------------------------------
+
+
+def test_chunk_shape_reuse_no_recompiles():
+    """Two flushes with different lane counts ride the SAME padded
+    chunk (the backend's power-of-2 bucket), so the second flush is a
+    shadow-jit-cache HIT — zero recompiles, zero cold misses."""
+    jax = pytest.importorskip("jax")
+    del jax
+    import secrets
+
+    from tendermint_tpu.crypto import backend as cb
+    from tendermint_tpu.crypto import pure_ed25519 as ref
+    be = cb.TpuBackend()
+    seeds = [secrets.token_bytes(32) for _ in range(V)]
+    vp = np.frombuffer(b"".join(ref.pubkey_from_seed(s) for s in seeds),
+                       np.uint8).reshape(V, 32)
+
+    def mk(n):
+        idx = (np.arange(n) % V).astype(np.int32)
+        msgs = [secrets.token_bytes(MSG_LEN) for _ in range(n)]
+        sigs = [ref.sign(seeds[idx[i]], msgs[i]) for i in range(n)]
+        return (idx,
+                np.frombuffer(b"".join(msgs), np.uint8).reshape(n, MSG_LEN),
+                np.frombuffer(b"".join(sigs), np.uint8).reshape(n, 64))
+
+    p = BatchPlane(target_lanes=16, max_flush_lanes=64)
+    try:
+        def via_backend(subs):
+            idx = np.concatenate([s.arrays[1] for s in subs])
+            msgs = np.concatenate([s.arrays[2] for s in subs])
+            sigs = np.concatenate([s.arrays[3] for s in subs])
+            return be.verify_grouped(subs[0].key[1], subs[0].arrays[0],
+                                     idx, msgs, sigs)
+        # Patch the INSTANCE, not the class: class-level save/restore of a
+        # staticmethod re-binds the raw function as a normal method and
+        # poisons every later plane in the process.
+        p._run_grouped = via_backend
+
+        idx, msgs, sigs = mk(12)        # bucket 16: warms the executable
+        assert p.submit_grouped(b"chunk-set", vp, idx, msgs, sigs,
+                                producer="fastsync", klass="fastsync",
+                                max_wait=0.05).wait().all()
+        h0 = REGISTRY.xla_cache_hits.value
+        m0 = REGISTRY.xla_cache_misses.value
+        r0 = REGISTRY.xla_recompiles.value
+        idx, msgs, sigs = mk(16)        # different count, SAME bucket
+        assert p.submit_grouped(b"chunk-set", vp, idx, msgs, sigs,
+                                producer="light", klass="light",
+                                max_wait=0.05).wait().all()
+        assert REGISTRY.xla_cache_hits.value > h0
+        assert REGISTRY.xla_cache_misses.value == m0
+        assert REGISTRY.xla_recompiles.value == r0
+    finally:
+        p.stop()
+
+
+# -- inline bypass ---------------------------------------------------------
+
+
+def test_disabled_plane_executes_inline(monkeypatch):
+    monkeypatch.setenv("TM_BATCHPLANE", "0")
+    calls = []
+    _stub_grouped(monkeypatch, calls)
+    p = BatchPlane(target_lanes=1024)
+    vp, idx, msgs, sigs = _mk_grouped(3)
+    out = p.submit_grouped(SET_KEY, vp, idx, msgs, sigs,
+                           producer="light", klass="light").wait()
+    assert out.all() and calls == [3]
+    assert p._thread is None            # no worker ever started
+    p.stop()
+
+
+# -- secp256k1 lane --------------------------------------------------------
+
+
+def test_secp_lane_coalesces_and_rejects_bad_sig():
+    secp = pytest.importorskip("tendermint_tpu.crypto.secp256k1")
+    if not secp.AVAILABLE:
+        pytest.skip("cryptography package unavailable")
+    priv = secp.PrivKeySecp256k1.generate()
+    msg_a, msg_b = b"a" * 32, b"b" * 32
+    p = BatchPlane(target_lanes=1024)
+    try:
+        sub = p.submit_secp(
+            [(priv.pub_key.bytes_, msg_a, priv.sign(msg_a)),
+             (priv.pub_key.bytes_, msg_b, priv.sign(msg_a))],  # bad lane
+            producer="mempool", klass="mempool", max_wait=0.05)
+        assert sub.wait().tolist() == [True, False]
+    finally:
+        p.stop()
+
+
+# -- mempool signed-tx envelope -------------------------------------------
+
+
+class _OkProxy:
+    def __init__(self):
+        self.seen = []
+
+    def check_tx(self, tx):
+        from tendermint_tpu.abci.types import Result
+        self.seen.append(tx)
+        return Result()
+
+
+@pytest.fixture
+def pool(monkeypatch):
+    # scalar-verify stand-in for the device batch: envelope routing and
+    # plane scheduling are what's under test, not the jit kernels
+    import tendermint_tpu.crypto.backend as cb
+    from tendermint_tpu.types.keys import _verify_memo
+
+    def scalar_batch(pubs, msgs, sigs):
+        return np.asarray([_verify_memo(bytes(p), bytes(m), bytes(s))
+                           for p, m, s in zip(pubs, msgs, sigs)], bool)
+
+    monkeypatch.setattr(cb, "verify_batch", scalar_batch)
+    from tendermint_tpu.mempool.mempool import Mempool
+    return Mempool(_OkProxy())
+
+
+def test_mempool_admits_valid_ed25519_envelope(pool):
+    from tendermint_tpu.mempool.mempool import sign_tx_ed25519
+    seed = b"\x07" * 32
+    tx = sign_tx_ed25519(seed, b"transfer:alice:bob:5")
+    res = pool.check_tx(tx)
+    assert res is not None and res.is_ok
+    assert pool.size() == 1
+    assert pool.proxy.seen == [tx]
+
+
+def test_mempool_rejects_forged_signature_before_app(pool):
+    from tendermint_tpu.abci.types import ERR_BAD_SIG
+    from tendermint_tpu.mempool.mempool import sign_tx_ed25519
+    tx = bytearray(sign_tx_ed25519(b"\x07" * 32, b"payload"))
+    tx[40] ^= 0x01                        # corrupt the signature
+    res = pool.check_tx(bytes(tx))
+    assert res.code == ERR_BAD_SIG
+    assert pool.size() == 0
+    assert pool.proxy.seen == []          # app never saw the forgery
+    # rejection is not a permanent dedup: the FIXED tx may resubmit
+    good = sign_tx_ed25519(b"\x07" * 32, b"payload")
+    assert pool.check_tx(good).is_ok
+
+
+def test_mempool_rejects_malformed_envelope(pool):
+    from tendermint_tpu.abci.types import ERR_ENCODING
+    from tendermint_tpu.mempool.mempool import TAG_ED25519
+    res = pool.check_tx(bytes([TAG_ED25519]) + b"short")
+    assert res.code == ERR_ENCODING
+    assert pool.proxy.seen == []
+
+
+def test_mempool_unsigned_txs_bypass_signature_gate(pool):
+    res = pool.check_tx(b"plain-unsigned-tx")
+    assert res.is_ok and pool.size() == 1
+
+
+def test_mempool_secp_envelope_roundtrip(pool):
+    secp = pytest.importorskip("tendermint_tpu.crypto.secp256k1")
+    if not secp.AVAILABLE:
+        pytest.skip("cryptography package unavailable")
+    from tendermint_tpu.abci.types import ERR_BAD_SIG
+    from tendermint_tpu.mempool.mempool import sign_tx_secp256k1
+    priv = secp.PrivKeySecp256k1.generate()
+    tx = sign_tx_secp256k1(priv, b"secp-payload")
+    assert pool.check_tx(tx).is_ok
+    bad = bytearray(sign_tx_secp256k1(priv, b"other-payload"))
+    bad[-1] ^= 0xFF                       # payload no longer matches sig
+    assert pool.check_tx(bytes(bad)).code == ERR_BAD_SIG

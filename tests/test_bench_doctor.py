@@ -42,7 +42,7 @@ def test_bench_doctor_report_and_ledger(tmp_path):
     # -- doctor report schema + partition invariant ----------------------
     assert report["schema"] == "tpu-bft-doctor/1"
     assert report["window_count"] >= 1
-    assert report["largest_thief"] in _PARTITION
+    assert report["largest_thief"] in _PARTITION + ("half_full_batches",)
     for w in report["windows"]:
         parts = sum(w[k] for k in _PARTITION)
         assert abs(parts - w["wall"]) <= 0.1 * w["wall"] + 1e-6, w

@@ -18,11 +18,13 @@ STRESS = sorted(n for n, sc in SCENARIOS.items() if not sc.smoke)
 
 
 def test_stress_catalog_is_what_we_think():
-    assert STRESS == ["crash-restart-storm", "device-storm-partition",
+    assert STRESS == ["batchplane-flood-isolation", "crash-restart-storm",
+                      "device-storm-partition",
                       "equivocation-crash-restart",
                       "live-rounds-100-chaos", "live-rounds-50",
                       "partial-commit-replay",
                       "partition-heal", "partition-heal-25",
+                      "snapshot-join", "snapshot-tamper",
                       "stale-commit-replay", "stale-replay-partition"]
 
 

@@ -478,6 +478,61 @@ def test_scenario_budget_statesync_registration_shapes(tmp_path):
     assert "snapshot-join-naked" in hits[0].message
 
 
+# -- batch-plane producer discipline ---------------------------------------
+
+
+def test_batchplane_flags_direct_backend_call_in_producer(tmp_path):
+    findings = lint_src(tmp_path, """
+        from tendermint_tpu.crypto import backend as cb
+
+        def verify_commit_any(new_set, idxs, msgs, sigs):
+            return cb.verify_grouped(new_set.set_key(),
+                                     new_set.pubs_matrix(), idxs,
+                                     msgs, sigs)
+        """, relpath="light/client.py")
+    hits = [f for f in findings if f.rule == "batchplane-producer"]
+    assert len(hits) == 1, findings
+    assert "cb.verify_grouped" in hits[0].message
+
+
+def test_batchplane_flags_from_import_alias(tmp_path):
+    findings = lint_src(tmp_path, """
+        from tendermint_tpu.crypto.backend import verify_batch as vb
+
+        def check_sigs(pubs, msgs, sigs):
+            return vb(pubs, msgs, sigs)
+        """, relpath="mempool/mempool.py")
+    hits = [f for f in findings if f.rule == "batchplane-producer"]
+    assert len(hits) == 1, findings
+
+
+def test_batchplane_quiet_on_plane_submission_twin(tmp_path):
+    findings = lint_src(tmp_path, """
+        from tendermint_tpu import batchplane
+
+        def verify_commit_any(new_set, idxs, msgs, sigs):
+            return batchplane.verify_grouped(
+                new_set.set_key(), new_set.pubs_matrix(), idxs, msgs,
+                sigs, producer="light", klass=batchplane.CLASS_LIGHT)
+        """, relpath="light/client.py")
+    assert not [f for f in findings if f.rule == "batchplane-producer"]
+
+
+def test_batchplane_allows_scheduler_and_bench_direct_calls(tmp_path):
+    # the scheduler itself and non-producer layers stay direct by design
+    src = """
+        from tendermint_tpu.crypto import backend as cb
+
+        def _run_grouped(set_key, val_pubs, idx, msgs, sigs):
+            return cb.verify_grouped(set_key, val_pubs, idx, msgs, sigs)
+        """
+    for rel in ("batchplane/scheduler.py", "crypto/supervised.py",
+                "bench.py"):
+        findings = lint_src(tmp_path, src, relpath=rel)
+        assert not [f for f in findings
+                    if f.rule == "batchplane-producer"], rel
+
+
 def test_rule_catalog_covers_all_families():
     from tendermint_tpu.analysis import all_rules
     names = {n for n, _ in all_rules()}
@@ -485,4 +540,4 @@ def test_rule_catalog_covers_all_families():
             "jax-retrace", "jax-static-argnums", "route-gating",
             "route-write-containment", "span-category",
             "bench-scalar-loop", "metric-name",
-            "scenario-budget"} <= names
+            "scenario-budget", "batchplane-producer"} <= names
