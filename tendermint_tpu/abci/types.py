@@ -14,6 +14,11 @@ OK = 0
 ERR_ENCODING = 1
 ERR_BAD_NONCE = 2
 ERR_BAD_SIG = 3
+# admission-control rejection (mempool/mempool.py): the pool (or the
+# verify plane feeding it) is at capacity and the tx did not outrank
+# anything evictable — a LOAD signal, not a verdict on the tx, so
+# clients may back off and resubmit (the hash is NOT cached)
+ERR_MEMPOOL_FULL = 4
 ERR_UNKNOWN = 99
 
 

@@ -451,6 +451,16 @@ class BatchPlane:
         with self._cond:
             return sum(len(b.subs) for b in self._pending.values())
 
+    def class_depth(self, klass: str) -> int:
+        """Pending LANES carrying `klass` submissions.  The mempool's
+        admission backpressure probes this before verifying: when the
+        mempool class already queues more lanes than it can drain, new
+        enveloped txs are rejected at the front door instead of growing
+        the queue under the consensus class."""
+        with self._cond:
+            return sum(s.n for b in self._pending.values()
+                       for s in b.subs if s.klass == klass)
+
 
 def _chunk(n: int) -> int:
     """The padded chunk size `n` lanes will ride (the backend's

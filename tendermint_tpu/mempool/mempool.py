@@ -1,4 +1,5 @@
-"""Ordered transaction pool gated by app CheckTx.
+"""Ordered transaction pool gated by app CheckTx, behind an admission
+controller that survives ingress overload.
 
 Reference: `mempool/mempool.go` — txs enter via CheckTx on the dedicated
 mempool ABCI conn (`:166-205`), LRU dedup cache of 100k (`:51,410-469`),
@@ -9,65 +10,115 @@ and the lock consensus holds across app Commit (`state/execution.go:248`).
 The reference's concurrent linked list (tmlibs/clist) becomes an ordered
 dict under one re-entrant lock: iteration order == insertion order, O(1)
 removal on update, safe concurrent CheckTx from RPC threads.
+
+Admission control (ROADMAP item 3, the "millions of users" front door):
+
+- hard caps on resident txs (`mempool.max_txs`) and bytes
+  (`mempool.max_bytes`); at the cap a new tx is admitted only by
+  evicting strictly lower-priority txs, else rejected with the typed
+  `ERR_MEMPOOL_FULL` result (surfaced verbatim through the RPC
+  `broadcast_tx_*` paths)
+- reject-before-verify backpressure: while the batch plane's mempool
+  class queues more than `mempool.backpressure_lanes` pending lanes,
+  enveloped txs are refused BEFORE their signature is scheduled, so a
+  flood sheds at the front door instead of starving consensus lanes
+- priority eviction: the envelope carries an authenticated fee/priority
+  byte; victims are chosen lowest-priority-oldest first and their
+  hashes leave the dedup cache, so a legitimately evicted tx can be
+  resubmitted once load drops
+- zero silent drops: every submission lands in exactly one outcome —
+  the pool, or `mempool_rejected{reason}` — and every eviction in
+  `mempool_evicted{reason}`; `mempool_admit_seconds` histograms the
+  admission latency the mempool-flood scenario budgets at p50/p99.
 """
 
 from __future__ import annotations
 
 import hashlib
 import os
+import time
 from collections import OrderedDict
 
-from tendermint_tpu.abci.types import ERR_BAD_SIG, ERR_ENCODING, Result
+from tendermint_tpu.abci.types import (ERR_BAD_SIG, ERR_ENCODING,
+                                       ERR_MEMPOOL_FULL, Result)
+from tendermint_tpu.types import merkle
 from tendermint_tpu.types.tx import Tx
 from tendermint_tpu.utils import lockwitness
 from tendermint_tpu.utils.chaos import DeviceFault
+from tendermint_tpu.utils.metrics import REGISTRY
 
 # -- signed-tx envelope ----------------------------------------------------
-# Optional authenticated tx framing: a tagged prefix carries the sender's
-# key and a signature over sha256(payload), so the pool can reject forged
-# submissions BEFORE the app sees them — on the device batch plane, where
-# concurrent RPC CheckTx lanes coalesce into one verify batch.  The
-# signature covers the payload DIGEST (fixed 32-byte message) so every
-# lane shares one compiled shape regardless of payload size.  Unprefixed
-# txs skip the check entirely (the app's own CheckTx still runs).
-TAG_ED25519 = 0xE1      # [tag][pub 32][sig 64][payload...]
-TAG_SECP256K1 = 0xE2    # [tag][pub 33][siglen 1][sig][payload...]
+# Optional authenticated tx framing: a tagged prefix carries a fee/priority
+# byte, the sender's key and a signature over sha256(priority || payload),
+# so the pool can reject forged submissions BEFORE the app sees them — on
+# the device batch plane, where concurrent RPC CheckTx lanes coalesce into
+# one verify batch.  The signature covers the DIGEST (fixed 32-byte
+# message) so every lane shares one compiled shape regardless of payload
+# size, and covers the priority byte so a relay cannot bump or slash a
+# tx's eviction rank in flight.  Unprefixed txs skip the check entirely
+# (the app's own CheckTx still runs) and rank at priority 0.
+TAG_ED25519 = 0xE1      # [tag][prio 1][pub 32][sig 64][payload...]
+TAG_SECP256K1 = 0xE2    # [tag][prio 1][pub 33][siglen 1][sig][payload...]
 
 
-def sign_tx_ed25519(seed: bytes, payload: bytes) -> bytes:
+def _priority_digest(priority: int, payload: bytes) -> bytes:
+    if not 0 <= priority <= 255:
+        raise ValueError(f"tx priority {priority} outside 0..255")
+    return hashlib.sha256(bytes([priority]) + payload).digest()
+
+
+def sign_tx_ed25519(seed: bytes, payload: bytes,
+                    priority: int = 0) -> bytes:
     """Wrap payload in the ed25519 envelope (test/fixture helper)."""
     from tendermint_tpu.types.keys import PrivKey
     priv = PrivKey(seed)
-    digest = hashlib.sha256(payload).digest()
-    return (bytes([TAG_ED25519]) + priv.pub_key.bytes_ +
+    digest = _priority_digest(priority, payload)
+    return (bytes([TAG_ED25519, priority]) + priv.pub_key.bytes_ +
             priv.sign(digest) + payload)
 
 
-def sign_tx_secp256k1(priv, payload: bytes) -> bytes:
+def sign_tx_secp256k1(priv, payload: bytes, priority: int = 0) -> bytes:
     """Wrap payload in the secp256k1 envelope (`PrivKeySecp256k1`)."""
-    digest = hashlib.sha256(payload).digest()
+    digest = _priority_digest(priority, payload)
     sig = priv.sign(digest)
-    return (bytes([TAG_SECP256K1]) + priv.pub_key.bytes_ +
+    return (bytes([TAG_SECP256K1, priority]) + priv.pub_key.bytes_ +
             bytes([len(sig)]) + sig + payload)
 
 
 def parse_signed_tx(tx: bytes):
-    """(scheme, pub, sig, payload) for enveloped txs, None for unsigned.
+    """(scheme, pub, sig, payload, priority) for enveloped txs, None
+    for unsigned.
 
     Raises ValueError on a malformed envelope: a tx claiming a signature
     scheme must never fall through as unsigned."""
     if not tx or tx[0] not in (TAG_ED25519, TAG_SECP256K1):
         return None
     if tx[0] == TAG_ED25519:
-        if len(tx) < 1 + 32 + 64 + 1:
+        if len(tx) < 2 + 32 + 64 + 1:
             raise ValueError("ed25519 envelope truncated")
-        return ("ed25519", tx[1:33], tx[33:97], tx[97:])
-    if len(tx) < 1 + 33 + 1 + 1:
+        return ("ed25519", tx[2:34], tx[34:98], tx[98:], tx[1])
+    if len(tx) < 2 + 33 + 1 + 1 + 1:
         raise ValueError("secp256k1 envelope truncated")
-    siglen = tx[34]
-    if siglen == 0 or len(tx) < 1 + 33 + 1 + siglen + 1:
+    siglen = tx[35]
+    if siglen == 0 or len(tx) < 2 + 33 + 1 + siglen + 1:
         raise ValueError("secp256k1 envelope truncated")
-    return ("secp256k1", tx[1:34], tx[35:35 + siglen], tx[35 + siglen:])
+    return ("secp256k1", tx[2:35], tx[36:36 + siglen],
+            tx[36 + siglen:], tx[1])
+
+
+def tx_priority(tx: bytes) -> int:
+    """Fee/priority byte of an enveloped tx; unsigned txs rank 0."""
+    parsed = parse_signed_tx(tx)
+    return 0 if parsed is None else parsed[4]
+
+
+# shared rejection Results: at flood rates these fire 100k+/s, and the
+# dataclass construction is a measurable slice of the shed budget —
+# callers treat Results as read-only
+_RES_FULL = Result(code=ERR_MEMPOOL_FULL, log="mempool is full")
+_RES_BACKPRESSURE = Result(
+    code=ERR_MEMPOOL_FULL,
+    log="mempool backpressure: verify plane saturated")
 
 
 class Mempool:
@@ -75,6 +126,12 @@ class Mempool:
         self.proxy = proxy_mempool_conn
         cache_size = config.cache_size if config else 100_000
         self.recheck_enabled = config.recheck if config else True
+        # admission caps (getattr: a pre-admission MempoolConfig or a
+        # bare stub still constructs a working pool on the defaults)
+        self.max_txs = getattr(config, "max_txs", 5_000)
+        self.max_bytes = getattr(config, "max_bytes", 1_073_741_824)
+        self.backpressure_lanes = getattr(config, "backpressure_lanes",
+                                          4_096)
         self._txs: OrderedDict[bytes, bytes] = OrderedDict()  # hash -> tx
         self._cache: OrderedDict[bytes, None] = OrderedDict()
         self._cache_size = cache_size
@@ -87,6 +144,22 @@ class Mempool:
         self._recovering = False
         self._notify_cbs: list = []   # gossip wakeups on pool change
         self._tx_heights: dict[bytes, int] = {}   # hash -> admission height
+        self._tx_prio: dict[bytes, int] = {}      # hash -> priority byte
+        self._bytes = 0                           # resident tx bytes
+        # cached min priority over the pool: the O(1) shortcut that lets
+        # a full pool shed can't-possibly-fit floods without the O(n)
+        # victim scan; recomputed lazily after the floor tx leaves
+        self._prio_floor = 0
+        self._floor_dirty = True
+        # observation hook for eviction audits (eviction-storm records
+        # (hash, tx, priority) of every victim); fired under the lock
+        self.on_evict = None
+        # pre-bound metric cells: CounterVec.labels() takes a lock per
+        # call, and the flood-shed path pays it on every rejection
+        self._rejected = {r: REGISTRY.mempool_rejected.labels(r)
+                          for r in ("encoding", "dup", "full",
+                                    "backpressure", "bad_sig", "app")}
+        self._evicted_prio = REGISTRY.mempool_evicted.labels("priority")
 
     def add_notify_cb(self, cb) -> None:
         """Register a zero-arg callback fired whenever the pool gains a
@@ -115,34 +188,87 @@ class Mempool:
         self._lock.release()
 
     # -- ingestion -------------------------------------------------------
-    def check_tx(self, tx: bytes):
-        """Admit via app CheckTx; returns the app Result or None when the
-        tx is a cache duplicate (reference `:166-205`).
+    def check_tx(self, tx: bytes, tx_hash: bytes | None = None):
+        """Admit via the admission controller + app CheckTx; returns the
+        Result or None when the tx is a cache duplicate (reference
+        `:166-205`).  Every submission is timed into
+        `mempool_admit_seconds` and lands in exactly one outcome.
+        `tx_hash`, when the caller already computed it (the RPC
+        broadcast handlers hash every tx for their response), skips the
+        second leaf-hash — at flood rates the duplicate sha256 is a
+        measurable slice of the admission budget."""
+        t0 = time.perf_counter()
+        try:
+            return self._admit(tx, tx_hash if tx_hash is not None
+                               else merkle.leaf_hash(tx))
+        finally:
+            REGISTRY.mempool_admit_seconds.observe(
+                time.perf_counter() - t0)
 
-        The app call happens UNDER the mempool lock: consensus holds this
-        lock across app Commit + update (reference proxyMtx semantics), so
-        no tx can validate against a half-committed app and then slip into
-        the pool after the recheck pass.  The signed-envelope verify runs
-        OUTSIDE the lock (it is app-state independent) so concurrent RPC
-        CheckTx lanes coalesce on the device batch plane instead of
-        serializing a device round-trip each behind the pool lock.
-        """
-        h = Tx(tx).hash
-        with self._lock:
-            if h in self._cache:
-                return None
-            self._cache[h] = None
-            while len(self._cache) > self._cache_size:
-                self._cache.popitem(last=False)
-        rej = self._verify_signed(tx)
-        if rej is not None:
+    def _admit(self, tx: bytes, h: bytes):
+        """The admission pipeline, cheapest gate first:
+
+        envelope parse (priority) -> dedup cache -> backpressure
+        (reject-before-verify) -> capacity/evictability -> signature
+        verify (batch plane) -> app CheckTx -> evict + insert.
+
+        The app call happens UNDER the mempool lock: consensus holds
+        this lock across app Commit + update (reference proxyMtx
+        semantics), so no tx can validate against a half-committed app
+        and then slip into the pool after the recheck pass.  The
+        signed-envelope verify runs OUTSIDE the lock (it is app-state
+        independent) so concurrent RPC CheckTx lanes coalesce on the
+        device batch plane instead of serializing a device round-trip
+        each behind the pool lock.  Unsigned txs skip the verify legs
+        entirely and resolve in ONE lock section — the flood-shed path
+        a saturated pool serves at 100k+/s."""
+        try:
+            parsed = parse_signed_tx(tx)
+        except ValueError as e:
+            # malformed envelopes never enter the dedup cache: nothing
+            # to uncache, and a resubmission re-parses to the same error
+            self._rejected["encoding"].inc()
+            return Result(code=ERR_ENCODING,
+                          log=f"bad signed-tx envelope: {e}")
+        prio = parsed[4] if parsed is not None else 0
+        if parsed is not None:
             with self._lock:
-                # bad signature: allow future resubmission of a fixed tx
-                self._cache.pop(h, None)
-            return rej
+                if not self._cache_admit_locked(h):
+                    return None
+            if self._backpressured():
+                # reject BEFORE scheduling the verify: a signature flood
+                # must not grow the plane's mempool queue unboundedly
+                return self._reject(h, "backpressure", _RES_BACKPRESSURE)
+            with self._lock:
+                if self._find_victims_locked(len(tx), prio) is None:
+                    # full and nothing strictly lower-priority to evict:
+                    # reject before paying for the signature verify
+                    return self._reject(h, "full", _RES_FULL)
+            rej = self._verify_signed(parsed)
+            if rej is not None:
+                reason = ("bad_sig" if rej.code == ERR_BAD_SIG
+                          else "encoding")
+                return self._reject(h, reason, rej)
         with self._lock:
+            if parsed is None and not self._cache_admit_locked(h):
+                return None
+            # capacity may have shifted while the verify ran off-lock:
+            # re-pick victims under the lock that admits
+            victims = self._find_victims_locked(len(tx), prio)
+            if victims is None:
+                # inline uncache+count (no _reject re-lock): this is
+                # the bulk flood-shed exit, one lock section end to end
+                self._cache.pop(h, None)
+                self._rejected["full"].inc()
+                return _RES_FULL
             res = self.proxy.check_tx(tx)
             if res.is_ok:
+                for v in victims:
+                    self._evict_locked(v)
+                if victims:
+                    # journal == surviving pool: a crash after the
+                    # eviction must not resurrect the victims
+                    self._rewrite_wal()
                 if self._wal is not None and not self._recovering:
                     self._wal.write(len(tx).to_bytes(4, "big") + tx)
                     self._wal.flush()
@@ -151,28 +277,121 @@ class Mempool:
                 # at — the gossip height-gate keys on THIS, not the pool's
                 # moving height (old txs must not be re-gated forever)
                 self._tx_heights[h] = self._height + 1
+                self._tx_prio[h] = prio
+                self._bytes += len(tx)
+                if not self._floor_dirty and prio < self._prio_floor:
+                    self._prio_floor = prio
+                self._set_gauges_locked()
                 self._notify_available()
                 self._fire_notify()
             else:
                 # invalid tx: allow future resubmission (reference :259-264)
                 self._cache.pop(h, None)
+                self._rejected["app"].inc()
         return res
 
-    def _verify_signed(self, tx: bytes):
+    def _cache_admit_locked(self, h: bytes) -> bool:
+        """Claim `h` in the dedup cache; False (+ counted rejection)
+        when it is already there."""
+        if h in self._cache:
+            self._rejected["dup"].inc()
+            return False
+        self._cache[h] = None
+        while len(self._cache) > self._cache_size:
+            self._cache.popitem(last=False)
+        return True
+
+    def _reject(self, h: bytes, reason: str, res: Result) -> Result:
+        """Uncache + count: a rejected tx is never silently dropped and
+        never permanently deduped — a client may resubmit once load
+        drops (or with the signature fixed)."""
+        with self._lock:
+            self._cache.pop(h, None)
+        self._rejected[reason].inc()
+        return res
+
+    # -- admission control ----------------------------------------------
+    def _backpressured(self) -> bool:
+        if self.backpressure_lanes <= 0:
+            return False
+        from tendermint_tpu import batchplane
+        if not batchplane.enabled():
+            return False
+        return (batchplane.get_plane().class_depth(
+            batchplane.CLASS_MEMPOOL) >= self.backpressure_lanes)
+
+    def _prio_floor_locked(self) -> int:
+        with self._lock:         # re-entrant; callers already hold it
+            if self._floor_dirty:
+                self._prio_floor = min(self._tx_prio.values(), default=0)
+                self._floor_dirty = False
+            return self._prio_floor
+
+    def _find_victims_locked(self, nbytes: int, prio: int):
+        """Eviction plan admitting a `prio` tx of `nbytes`: [] when it
+        fits outright, the lowest-priority-oldest victim hashes when
+        evicting strictly lower-priority txs makes room, None when the
+        tx must be rejected (nothing evictable outranks it).  Priority
+        inversion is impossible by construction: victims are consumed
+        in (priority, insertion-order) order and only while < prio."""
+        slots_full = (self.max_txs > 0
+                      and len(self._txs) + 1 > self.max_txs)
+        bytes_full = (self.max_bytes > 0
+                      and self._bytes + nbytes > self.max_bytes)
+        if not (slots_full or bytes_full):
+            return []
+        if prio <= self._prio_floor_locked():
+            return None          # O(1) shed: nothing in the pool ranks lower
+        victims: list[bytes] = []
+        vbytes = 0
+        candidates = sorted(
+            ((self._tx_prio.get(hh, 0), i, hh)
+             for i, hh in enumerate(self._txs)),
+            key=lambda t: (t[0], t[1]))
+        for p, _, hh in candidates:
+            if p >= prio:
+                break
+            victims.append(hh)
+            vbytes += len(self._txs[hh])
+            slots_ok = (self.max_txs <= 0 or
+                        len(self._txs) - len(victims) + 1 <= self.max_txs)
+            bytes_ok = (self.max_bytes <= 0 or
+                        self._bytes - vbytes + nbytes <= self.max_bytes)
+            if slots_ok and bytes_ok:
+                return victims
+        return None
+
+    def _evict_locked(self, h: bytes) -> None:
+        tx = self._txs.pop(h)
+        self._bytes -= len(tx)
+        p = self._tx_prio.pop(h, 0)
+        if p <= self._prio_floor:
+            self._floor_dirty = True
+        self._tx_heights.pop(h, None)
+        # evicted != committed: the dedup cache entry goes too, so a
+        # legitimate sender can resubmit once there is room
+        self._cache.pop(h, None)
+        self._evicted_prio.inc()
+        if self.on_evict is not None:
+            try:
+                self.on_evict(h, tx, p)
+            except Exception:
+                pass
+
+    def _set_gauges_locked(self) -> None:
+        REGISTRY.mempool_size.set(len(self._txs))
+        REGISTRY.mempool_bytes.set(self._bytes)
+
+    def _verify_signed(self, parsed):
         """Envelope signature gate: None when tx may proceed to the app,
         else the rejecting `Result`.  ed25519 lanes ride the batch plane
         (mempool class — preempted by consensus votes); a `DeviceFault`
         that survives the supervised ladder falls back to the scalar
         verifier rather than rejecting a possibly-valid tx."""
-        try:
-            parsed = parse_signed_tx(tx)
-        except ValueError as e:
-            return Result(code=ERR_ENCODING,
-                          log=f"bad signed-tx envelope: {e}")
         if parsed is None:
             return None
-        scheme, pub, sig, payload = parsed
-        digest = hashlib.sha256(payload).digest()
+        scheme, pub, sig, payload, prio = parsed
+        digest = _priority_digest(prio, payload)
         from tendermint_tpu import batchplane
         if scheme == "secp256k1":
             from tendermint_tpu.crypto import secp256k1
@@ -261,6 +480,11 @@ class Mempool:
         with self._lock:
             return len(self._txs)
 
+    def size_bytes(self) -> int:
+        """Resident tx bytes (the max_bytes cap's numerator)."""
+        with self._lock:
+            return self._bytes
+
     def height(self) -> int:
         """Last committed height this pool was updated to (gossip gate)."""
         return self._height
@@ -299,8 +523,10 @@ class Mempool:
             self._notified_available = False
             for tx in committed_txs:
                 h = Tx(tx).hash
-                self._txs.pop(h, None)
+                if self._txs.pop(h, None) is not None:
+                    self._bytes -= len(tx)
                 self._tx_heights.pop(h, None)
+                self._tx_prio.pop(h, None)
                 self._cache[h] = None   # committed: permanently deduped
             if self.recheck_enabled and self._txs:
                 survivors = OrderedDict()
@@ -309,7 +535,11 @@ class Mempool:
                         survivors[h] = tx
                     else:
                         self._tx_heights.pop(h, None)
+                        self._tx_prio.pop(h, None)
+                        self._bytes -= len(tx)
                 self._txs = survivors
+            self._floor_dirty = True
+            self._set_gauges_locked()
             # compact the journal to the surviving pool: committed txs
             # must not be re-admitted (re-EXECUTED) by recover_wal
             self._rewrite_wal()
@@ -338,7 +568,11 @@ class Mempool:
         with self._lock:
             self._txs.clear()
             self._tx_heights.clear()
+            self._tx_prio.clear()
             self._cache.clear()
+            self._bytes = 0
+            self._floor_dirty = True
+            self._set_gauges_locked()
             self._rewrite_wal()   # journal == pool, or recovery resurrects
 
     def close(self) -> None:

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import threading
 
+from tendermint_tpu.types import merkle
 from tendermint_tpu.types.events import event_tx
 from tendermint_tpu.types.tx import Tx
 
@@ -332,16 +333,22 @@ class Routes:
     # -- mempool routes (reference rpc/core/mempool.go) ------------------
     def broadcast_tx_async(self, params: dict) -> dict:
         tx = _parse_tx(params)
-        threading.Thread(target=self.node.mempool.check_tx, args=(tx,),
-                         daemon=True).start()
-        return {"hash": _hexb(Tx(tx).hash)}
+        tx_hash = Tx(tx).hash
+        threading.Thread(target=self.node.mempool.check_tx,
+                         args=(tx, tx_hash), daemon=True).start()
+        return {"hash": _hexb(tx_hash)}
 
     def broadcast_tx_sync(self, params: dict) -> dict:
         tx = _parse_tx(params)
-        res = self.node.mempool.check_tx(tx)
+        # hash once, share with admission: the response needs it either
+        # way, and at flood rates the second sha256 (and even the Tx
+        # wrapper allocation) is real budget
+        tx_hash = merkle.leaf_hash(tx)
+        res = self.node.mempool.check_tx(tx, tx_hash=tx_hash)
         if res is None:
             raise ValueError("tx already in cache")
-        return {**_result_dict(res), "hash": _hexb(Tx(tx).hash)}
+        return {"code": res.code, "data": res.data.hex(),
+                "log": res.log, "hash": tx_hash.hex()}
 
     def broadcast_tx_commit(self, params: dict) -> dict:
         """CheckTx then wait for the DeliverTx event
@@ -359,7 +366,7 @@ class Routes:
         sub_id = f"btc-{tx_hash.hex()[:16]}"
         self.node.evsw.subscribe(sub_id, key, on_deliver)
         try:
-            check = self.node.mempool.check_tx(tx)
+            check = self.node.mempool.check_tx(tx, tx_hash=tx_hash)
             if check is None:
                 raise ValueError("tx already in cache")
             if not check.is_ok:
@@ -379,7 +386,8 @@ class Routes:
         return {"n_txs": len(txs), "txs": [_hexb(t) for t in txs]}
 
     def num_unconfirmed_txs(self, params: dict) -> dict:
-        return {"n_txs": self.node.mempool.size()}
+        return {"n_txs": self.node.mempool.size(),
+                "total_bytes": self.node.mempool.size_bytes()}
 
     def tx(self, params: dict) -> dict:
         """Tx lookup by hash (kv indexer required)."""

@@ -22,11 +22,13 @@ from tendermint_tpu.scenarios import catalog  # registers the shipped set
 from tendermint_tpu.scenarios import live    # registers the big-rig tier
 from tendermint_tpu.scenarios import statesync_scenarios  # snapshot tier
 from tendermint_tpu.scenarios import batchplane_scenarios  # verify plane
+from tendermint_tpu.scenarios import mempool_scenarios  # ingress overload
 from tendermint_tpu.scenarios.catalog import SMOKE_ORDER
 
 __all__ = ["CHAOS_RUN_SCHEMA", "DEFAULT_CHAOS_LEDGER", "DEFAULT_SEED",
            "KNOWN_BACKENDS", "SCENARIOS", "SMOKE_ORDER",
            "InvariantViolation", "ScenarioResult", "artifacts_root",
            "batchplane_scenarios", "catalog", "live",
-           "parse_seed_range", "register", "resolve_backend",
+           "mempool_scenarios", "parse_seed_range", "register",
+           "resolve_backend",
            "run_scenario", "run_sweep", "statesync_scenarios"]

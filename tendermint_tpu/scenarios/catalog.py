@@ -1337,4 +1337,5 @@ register(
 
 SMOKE_ORDER = ["device-wrong-answer", "evidence-flood",
                "byz-equivocation", "device-rung-walk",
-               "snapshot-torn-tail", "batchplane-isolation"]
+               "snapshot-torn-tail", "batchplane-isolation",
+               "eviction-storm"]

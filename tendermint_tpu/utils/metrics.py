@@ -356,6 +356,19 @@ class Registry:
             (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024))
         self.batchplane_wait_seconds = HistogramVec(
             "klass", Histogram.LATENCY_BOUNDS)
+        # mempool ingress plane (mempool/mempool.py admission
+        # controller): every submission lands in exactly one outcome —
+        # admitted into the pool or counted in rejected{reason} — and
+        # every eviction in evicted{reason}; that accounting identity
+        # is the zero-silent-drops invariant the eviction-storm
+        # scenario audits.  admit_seconds is the per-submission
+        # admission latency (dup/full rejects included) whose p50/p99
+        # the mempool-flood gate budgets.
+        self.mempool_size = Gauge()
+        self.mempool_bytes = Gauge()
+        self.mempool_rejected = CounterVec("reason")
+        self.mempool_evicted = CounterVec("reason")
+        self.mempool_admit_seconds = Histogram(Histogram.LATENCY_BOUNDS)
 
     def snapshot(self) -> dict:
         up = max(time.time() - self._start, 1e-9)
@@ -426,6 +439,12 @@ class Registry:
                 self.batchplane_queue_depth_hist.snapshot(),
             "batchplane_wait_seconds":
                 self.batchplane_wait_seconds.snapshot(),
+            "mempool_size": self.mempool_size.value,
+            "mempool_bytes": self.mempool_bytes.value,
+            "mempool_rejected": dict(self.mempool_rejected.items()),
+            "mempool_evicted": dict(self.mempool_evicted.items()),
+            "mempool_admit_seconds":
+                self.mempool_admit_seconds.snapshot(),
         }
 
 

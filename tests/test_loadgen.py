@@ -1,0 +1,91 @@
+"""Flood-generator semantics: seeded corpus determinism and the
+zero-silent-drops outcome classification that the overload scenarios
+audit against the mempool_rejected counters."""
+
+import random
+
+import pytest
+
+from tendermint_tpu.abci.types import (ERR_BAD_SIG, ERR_ENCODING,
+                                       ERR_MEMPOOL_FULL, OK)
+from tendermint_tpu.scenarios import loadgen
+
+
+def test_corpus_is_seed_deterministic():
+    mix = loadgen.Mix(unsigned=40, signed=4, bad_sig=2, dup_frac=0.25)
+    a = loadgen.build_corpus(random.Random(7), mix)
+    b = loadgen.build_corpus(random.Random(7), mix)
+    c = loadgen.build_corpus(random.Random(8), mix)
+    assert a == b
+    assert a != c
+    # dup_frac appends verbatim repeats on top of the unique entries
+    n_unique = mix.unsigned + mix.signed + mix.bad_sig
+    assert len(a) == n_unique + int(n_unique * mix.dup_frac)
+    assert len(set(e["tx"] for e in a)) == n_unique
+
+
+def test_corpus_contains_all_traffic_kinds():
+    from tendermint_tpu.mempool.mempool import parse_signed_tx
+    mix = loadgen.Mix(unsigned=10, signed=6, bad_sig=3, dup_frac=0.0)
+    corpus = loadgen.build_corpus(random.Random(3), mix)
+    signedish = [e for e in corpus
+                 if parse_signed_tx(bytes.fromhex(e["tx"])) is not None]
+    assert len(signedish) == mix.signed + mix.bad_sig
+    assert len(corpus) - len(signedish) == mix.unsigned
+
+
+def test_classify_maps_every_rpc_outcome():
+    def ok(p):
+        return {"code": OK}
+
+    def full(p):
+        return {"code": ERR_MEMPOOL_FULL, "log": "mempool is full"}
+
+    def backpressure(p):
+        return {"code": ERR_MEMPOOL_FULL,
+                "log": "mempool backpressure: verify plane saturated"}
+
+    def bad_sig(p):
+        return {"code": ERR_BAD_SIG, "log": "invalid signature"}
+
+    def encoding(p):
+        return {"code": ERR_ENCODING, "log": "bad envelope"}
+
+    def app(p):
+        return {"code": 77, "log": "app said no"}
+
+    def dup(p):
+        raise ValueError("tx already in cache")
+
+    def boom(p):
+        raise RuntimeError("transport died")
+
+    for call, want in ((ok, "admitted"), (full, "full"),
+                       (backpressure, "backpressure"),
+                       (bad_sig, "bad_sig"), (encoding, "encoding"),
+                       (app, "app"), (dup, "dup"), (boom, "error")):
+        got = loadgen.classify(call, {"tx": "00"})
+        assert got == want, (call.__name__, got)
+        assert got in loadgen.OUTCOMES
+
+
+def test_loadgen_accounts_every_submission():
+    """offered == sum of outcome buckets, across workers."""
+    hits = []
+
+    def call(params):
+        hits.append(params["tx"])
+        if len(hits) % 5 == 0:
+            raise ValueError("tx already in cache")
+        return {"code": OK}
+
+    corpus = [{"tx": "%04x" % i} for i in range(32)]
+    report = loadgen.LoadGen(call, corpus, workers=2).run(duration_s=0.2)
+    assert report.offered == len(hits)
+    assert sum(report.outcomes.values()) == report.offered
+    assert report.outcomes["error"] == 0
+    assert report.offered_per_sec == pytest.approx(
+        report.offered / report.duration_s)
+    s = report.summary()
+    assert s["offered"] == report.offered
+    assert set(s["outcomes"]) == set(loadgen.OUTCOMES)

@@ -1,10 +1,16 @@
-"""Mempool semantics: ordering, dedup, reap, post-commit recheck.
+"""Mempool semantics: ordering, dedup, reap, post-commit recheck, and
+the admission controller (caps, priority eviction, backpressure).
 
 Reference: `mempool/mempool_test.go` (204 LoC).
 """
 
+import numpy as np
+import pytest
+
 from tendermint_tpu.abci.app import create_app
-from tendermint_tpu.mempool.mempool import Mempool
+from tendermint_tpu.abci.types import ERR_MEMPOOL_FULL
+from tendermint_tpu.config import MempoolConfig
+from tendermint_tpu.mempool.mempool import Mempool, sign_tx_ed25519
 from tendermint_tpu.proxy import ClientCreator
 
 
@@ -139,3 +145,183 @@ def test_recover_wal_committed_filter(tmp_path):
     assert mp2.check_tx(b"f0=v") is None
     # a genuinely new tx is still admitted
     assert mp2.check_tx(b"f9=v").is_ok
+
+
+# -- admission control: caps, priority eviction, backpressure -------------
+
+
+@pytest.fixture
+def scalar_verify(monkeypatch):
+    """Scalar stand-in for the device verify batch: admission-control
+    semantics are under test here, not the jit kernels."""
+    import tendermint_tpu.crypto.backend as cb
+    from tendermint_tpu.types.keys import _verify_memo
+
+    def scalar_batch(pubs, msgs, sigs):
+        return np.asarray([_verify_memo(bytes(p), bytes(m), bytes(s))
+                           for p, m, s in zip(pubs, msgs, sigs)], bool)
+
+    monkeypatch.setattr(cb, "verify_batch", scalar_batch)
+
+
+def _capped(max_txs, wal_path="", app="kvstore", **kw):
+    conns = ClientCreator(app).new_app_conns()
+    cfg = MempoolConfig(max_txs=max_txs, backpressure_lanes=0, **kw)
+    return Mempool(conns.mempool, cfg, wal_path=wal_path)
+
+
+def test_full_rejection_pops_cache_and_is_retryable():
+    """ISSUE satellite: a tx bounced for capacity must leave the dedup
+    cache — rejection is a LOAD signal, not a verdict, so the same
+    bytes must be admittable once the pool drains."""
+    mp = _capped(2)
+    assert mp.check_tx(b"a=1").is_ok
+    assert mp.check_tx(b"b=2").is_ok
+    res = mp.check_tx(b"c=3")
+    assert res.code == ERR_MEMPOOL_FULL
+    assert mp.size() == 2
+    # NOT a cache-dup (would be None): the hash was popped on rejection
+    assert mp.check_tx(b"c=3").code == ERR_MEMPOOL_FULL
+    mp.update(1, [b"a=1", b"b=2"])
+    assert mp.check_tx(b"c=3").is_ok       # admitted after room opened
+
+
+def test_priority_eviction_lowest_oldest_first(scalar_verify):
+    mp = _capped(3)
+    low1 = sign_tx_ed25519(b"\x01" * 32, b"low-1", priority=1)
+    low2 = sign_tx_ed25519(b"\x02" * 32, b"low-2", priority=1)
+    mid = sign_tx_ed25519(b"\x03" * 32, b"mid", priority=3)
+    for tx in (low1, low2, mid):
+        assert mp.check_tx(tx).is_ok
+    evicted = []
+    mp.on_evict = lambda h, tx, p: evicted.append((tx, p))
+    high = sign_tx_ed25519(b"\x04" * 32, b"high", priority=7)
+    assert mp.check_tx(high).is_ok
+    # oldest of the lowest priority went first, exactly one victim
+    assert evicted == [(low1, 1)]
+    assert mp.reap(-1) == [low2, mid, high]
+    # the evicted tx left the dedup cache: resubmission is judged on
+    # its own (still-too-low) priority, not swallowed as a duplicate
+    assert mp.check_tx(low1).code == ERR_MEMPOOL_FULL
+
+
+def test_no_eviction_for_equal_or_lower_priority(scalar_verify):
+    mp = _capped(2)
+    a = sign_tx_ed25519(b"\x05" * 32, b"a", priority=4)
+    b = sign_tx_ed25519(b"\x06" * 32, b"b", priority=4)
+    for tx in (a, b):
+        assert mp.check_tx(tx).is_ok
+    equal = sign_tx_ed25519(b"\x07" * 32, b"equal", priority=4)
+    lower = sign_tx_ed25519(b"\x08" * 32, b"lower", priority=2)
+    assert mp.check_tx(equal).code == ERR_MEMPOOL_FULL
+    assert mp.check_tx(lower).code == ERR_MEMPOOL_FULL
+    assert mp.reap(-1) == [a, b]           # pool untouched
+
+
+def test_bytes_cap_and_byte_accounting():
+    mp = _capped(0, max_bytes=24)
+    assert mp.check_tx(b"k1=0123456789").is_ok      # 13 bytes
+    assert mp.check_tx(b"k2=0123456789").code == ERR_MEMPOOL_FULL
+    assert mp.check_tx(b"k3=tiny").is_ok            # 7 bytes still fits
+    assert mp.size_bytes() == 20
+    mp.update(1, [b"k1=0123456789"])
+    assert mp.size_bytes() == 7
+
+
+def test_backpressure_rejects_before_verify(scalar_verify, monkeypatch):
+    """Reject-before-verify: when the plane's mempool class is
+    saturated, a signed tx must bounce WITHOUT scheduling a verify or
+    touching the app."""
+    mp = _capped(10)
+    monkeypatch.setattr(mp, "_backpressured", lambda: True)
+
+    def boom(*a, **k):
+        raise AssertionError("verify scheduled despite backpressure")
+
+    monkeypatch.setattr(mp, "_verify_signed", boom)
+    tx = sign_tx_ed25519(b"\x09" * 32, b"bp", priority=9)
+    res = mp.check_tx(tx)
+    assert res.code == ERR_MEMPOOL_FULL
+    assert "backpressure" in res.log
+    assert mp.size() == 0
+    # backpressure is transient: once it lifts, the SAME tx is welcome
+    monkeypatch.setattr(mp, "_backpressured", lambda: False)
+    monkeypatch.undo()
+    assert mp.check_tx(tx).is_ok
+
+
+def test_unsigned_txs_skip_backpressure(monkeypatch):
+    """Backpressure guards the verify plane; unsigned txs never touch
+    it and must keep flowing while signed traffic is shed."""
+    mp = _capped(10)
+    monkeypatch.setattr(mp, "_backpressured", lambda: True)
+    assert mp.check_tx(b"plain=1").is_ok
+
+
+def test_flush_truncates_wal(tmp_path):
+    """ISSUE satellite: flush() must rewrite the journal, or recovery
+    resurrects a pool the operator explicitly dropped."""
+    wal = str(tmp_path / "mempool.wal")
+    conns = ClientCreator("kvstore").new_app_conns()
+    mp = Mempool(conns.mempool, wal_path=wal)
+    for i in range(3):
+        assert mp.check_tx(b"fl%d=v" % i).is_ok
+    mp.flush()
+    assert mp.size() == 0 and mp.size_bytes() == 0
+    import os
+    assert os.path.getsize(wal) == 0
+    conns2 = ClientCreator("kvstore").new_app_conns()
+    mp2 = Mempool(conns2.mempool, wal_path=wal)
+    assert mp2.recover_wal() == 0
+    assert mp2.size() == 0
+
+
+def test_wal_under_eviction_churn_recovers_survivors_only(
+        scalar_verify, tmp_path):
+    """ISSUE satellite: eviction rewrites the journal, so a crash after
+    an eviction storm recovers exactly the surviving set — never an
+    evicted tx."""
+    wal = str(tmp_path / "mempool.wal")
+    conns = ClientCreator("kvstore").new_app_conns()
+    cfg = MempoolConfig(max_txs=3, backpressure_lanes=0)
+    mp = Mempool(conns.mempool, cfg, wal_path=wal)
+    lows = [sign_tx_ed25519(bytes([i]) * 32, b"low-%d" % i, priority=1)
+            for i in range(3)]
+    for tx in lows:
+        assert mp.check_tx(tx).is_ok
+    highs = [sign_tx_ed25519(bytes([10 + i]) * 32, b"high-%d" % i,
+                             priority=8) for i in range(2)]
+    for tx in highs:
+        assert mp.check_tx(tx).is_ok       # each evicts one low
+    survivors = mp.reap(-1)
+    assert survivors == [lows[2]] + highs
+    # crash (no close): recovery re-admits the journal
+    conns2 = ClientCreator("kvstore").new_app_conns()
+    mp2 = Mempool(conns2.mempool, cfg, wal_path=wal)
+    assert mp2.recover_wal() == 3
+    recovered = mp2.reap(-1)
+    assert recovered == survivors
+    assert lows[0] not in recovered and lows[1] not in recovered
+
+
+def test_mempool_metrics_exposed(scalar_verify):
+    from tendermint_tpu.utils.metrics import REGISTRY, prometheus_text
+    base_full = dict(REGISTRY.mempool_rejected.items()).get("full", 0)
+    base_evic = dict(REGISTRY.mempool_evicted.items()).get("priority", 0)
+    mp = _capped(2)
+    assert mp.check_tx(b"m1=a").is_ok
+    assert mp.check_tx(b"m2=b").is_ok
+    assert mp.check_tx(b"m3=c").code == ERR_MEMPOOL_FULL
+    hi = sign_tx_ed25519(b"\x0c" * 32, b"hi", priority=5)
+    assert mp.check_tx(hi).is_ok           # evicts m1=a
+    assert REGISTRY.mempool_size.value == 2
+    assert REGISTRY.mempool_bytes.value == len(b"m2=b") + len(hi)
+    counts = dict(REGISTRY.mempool_rejected.items())
+    assert counts.get("full", 0) == base_full + 1
+    evic = dict(REGISTRY.mempool_evicted.items())
+    assert evic.get("priority", 0) == base_evic + 1
+    text = prometheus_text()
+    for needle in ("tendermint_mempool_size", "tendermint_mempool_bytes",
+                   "tendermint_mempool_rejected", "tendermint_mempool_evicted",
+                   "tendermint_mempool_admit_seconds_bucket"):
+        assert needle in text, needle

@@ -478,6 +478,49 @@ def test_scenario_budget_statesync_registration_shapes(tmp_path):
     assert "snapshot-join-naked" in hits[0].message
 
 
+def test_scenario_budget_mempool_registration_shapes(tmp_path):
+    # Golden twin of the mempool ingress registrations: the stress-tier
+    # flood gate declares min AND max bounds (an offered-load floor
+    # plus admission-latency ceilings), the smoke-tier eviction storm
+    # carries budgets it is not obliged to, and the variant that drops
+    # the flood's budgets kwarg is the seeded violation.
+    findings = lint_src(tmp_path, """
+        from tendermint_tpu.scenarios.engine import register
+
+        def _safety(ctx, obs):
+            pass
+
+        @register("mempool-flood-twin", "100k tx/s ingress flood",
+                  safety=[("zero-silent-drops", _safety)],
+                  liveness=[("rig-commits-through-flood", _safety)],
+                  smoke=False, budget_s=420.0, backend="rig",
+                  budgets={"offered_per_sec": {"min": 100000.0},
+                           "admit_p50_s": {"max": 0.001},
+                           "admit_p99_s": {"max": 0.25},
+                           "commit_latency_p99": {"max": 30.0}})
+        def flood_twin(ctx):
+            return {}
+
+        @register("eviction-storm-twin", "priority eviction audit",
+                  safety=[("no-priority-inversion", _safety)],
+                  liveness=[("storm-reached-overload", _safety)],
+                  smoke=True, budget_s=180.0,
+                  budgets={"priority_inversions": {"max": 0.0},
+                           "unaccounted_rejections": {"max": 0.0}})
+        def storm_twin(ctx):
+            return {}
+
+        @register("mempool-flood-naked", "flood without budgets",
+                  safety=[("s", _safety)], liveness=[("l", _safety)],
+                  smoke=False, budget_s=420.0, backend="rig")
+        def flood_naked(ctx):
+            return {}
+        """)
+    hits = [f for f in findings if f.rule == "scenario-budget"]
+    assert len(hits) == 1, findings
+    assert "mempool-flood-naked" in hits[0].message
+
+
 # -- batch-plane producer discipline ---------------------------------------
 
 
