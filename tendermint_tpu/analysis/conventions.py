@@ -18,6 +18,9 @@ names.
   ``cat=tracing.CAT_NONE`` for deliberately-uncategorized bookkeeping
   spans).  An uncategorized span silently drops out of the attribution
   partition and its wall clock reads as device_idle in the doctor.
+  The prefix table covers the consensus timeline plane too:
+  ``consensus.*`` and ``telemetry.*`` spans resolve to the
+  CAT_CONSENSUS / CAT_TELEMETRY flight-recorder categories.
 
 - **metric-name**: instrument attributes on a metrics registry render
   as ``tendermint_<attr>`` in the Prometheus 0.0.4 exposition; names
@@ -191,9 +194,10 @@ class RouteWriteContainmentRule(Rule):
 @register
 class SpanCategoryRule(Rule):
     name = "span-category"
-    description = ("span(\"name\") literals must resolve to an "
-                   "attribution category (known name prefix) or carry "
-                   "an explicit cat= keyword")
+    description = ("span(\"name\") literals must resolve to a "
+                   "flight-recorder category (known name prefix, "
+                   "including consensus./telemetry.) or carry an "
+                   "explicit cat= keyword")
 
     def visit_file(self, ctx: FileCtx):
         from tendermint_tpu.utils.tracing import default_category

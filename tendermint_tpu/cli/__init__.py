@@ -646,6 +646,58 @@ def cmd_doctor(args) -> int:
     return 0
 
 
+def cmd_timeline(args) -> int:
+    """Merged consensus timeline across a rig: collect every node's
+    height-lifecycle records (--rpc addr,addr,... via the unsafe
+    debug_timeline route, skew-normalized on each node's wall-clock
+    sample) or re-derive them from a dumped Chrome trace (--trace),
+    write a per-node-track Chrome trace to --out, and print the
+    consensus doctor report naming the largest thief per height
+    range."""
+    import time as _time
+    from tendermint_tpu import telemetry
+    if args.trace:
+        from tendermint_tpu.utils import attribution
+        with open(args.trace) as f:
+            records = telemetry.records_from_spans(
+                attribution.spans_from_chrome(json.load(f)))
+        merged = {"records": records, "dropped": {}, "offsets": {}}
+    else:
+        dumps = []
+        for addr in [a for a in args.rpc.split(",") if a.strip()]:
+            try:
+                d = _rpc_call(addr.strip(), "debug_timeline",
+                              {"last": args.last} if args.last else {})
+            except SystemExit:
+                raise
+            except Exception as e:   # a dead node degrades, not aborts
+                d = {"node": addr.strip(), "records": None,
+                     "error": str(e)}
+            dumps.append(d)
+        merged = telemetry.merge_dumps(dumps, ref_wall=_time.time())
+    timeline = telemetry.build_timeline(merged["records"])
+    report = telemetry.consensus_doctor(timeline, range_len=args.range)
+    if args.out:
+        trace = telemetry.to_chrome_trace(timeline)
+        tmp = args.out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(trace, f)
+        os.replace(tmp, args.out)
+    if args.json:
+        print(json.dumps({"timeline": timeline, "doctor": report,
+                          "dropped": merged["dropped"]}, indent=1))
+    else:
+        if args.out:
+            n = len(timeline["nodes"])
+            print(f"wrote timeline trace ({n} node tracks, heights "
+                  f"{timeline['height_range'][0]}.."
+                  f"{timeline['height_range'][1]}) to {args.out}")
+        for node, why in merged["dropped"].items():
+            print(f"dropped {node}: {why}")
+        print(telemetry.render_consensus_report(report))
+    return 0 if not merged["dropped"] else 1
+
+
 def cmd_bench_history(args) -> int:
     """Render the bench regression ledger: every recorded run's
     per-config rates with deltas vs the best PRIOR run, so a slow creep
@@ -1190,6 +1242,26 @@ def main(argv=None) -> int:
                     help="print the machine-readable report instead of "
                          "the human summary")
     sp.set_defaults(fn=cmd_doctor)
+
+    sp = sub.add_parser("timeline",
+                        help="merged consensus timeline: one Chrome "
+                             "track per node + consensus doctor report")
+    sp.add_argument("--rpc", default="http://127.0.0.1:26657",
+                    help="comma-separated node RPC addresses "
+                         "(unsafe debug_timeline route)")
+    sp.add_argument("--trace", default="",
+                    help="re-derive the timeline from a Chrome trace "
+                         "dump instead of RPC")
+    sp.add_argument("--out", default="timeline_trace.json",
+                    help="output Chrome trace path ('' to skip)")
+    sp.add_argument("--last", type=int, default=0,
+                    help="fetch only the N most recent heights per node")
+    sp.add_argument("--range", type=int, default=10,
+                    help="doctor height-range chunk length")
+    sp.add_argument("--json", action="store_true",
+                    help="print machine-readable timeline + doctor "
+                         "report")
+    sp.set_defaults(fn=cmd_timeline)
 
     sp = sub.add_parser("bench-history",
                         help="render the bench regression ledger with "

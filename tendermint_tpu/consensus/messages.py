@@ -11,7 +11,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 
 from tendermint_tpu.types import BlockID, Proposal, Vote
-from tendermint_tpu.types.codec import Reader, u32, u64, u8
+from tendermint_tpu.types.codec import Reader, lp_bytes, u32, u64, u8
 from tendermint_tpu.types.part_set import Part
 
 TAG_PROPOSAL = 0x01
@@ -24,6 +24,7 @@ TAG_VOTE_SET_MAJ23 = 0x14
 TAG_VOTE_SET_BITS = 0x15
 TAG_PROPOSAL_POL = 0x16
 TAG_PROPOSAL_HEARTBEAT = 0x17
+TAG_STAMPED = 0x18
 
 
 @dataclass(frozen=True)
@@ -98,6 +99,21 @@ class ProposalHeartbeatMessage:
     heartbeat: object          # types.proposal.Heartbeat
 
 
+@dataclass(frozen=True)
+class StampedMessage:
+    """Gossip envelope carrying the origin's send time (timeline plane).
+
+    Wraps a vote/proposal/block-part payload so the receiver can measure
+    per-link fan-out lag (ingest time minus sent_ts).  sent_ts rides the
+    sender's monotonic-anchored epoch axis (`tracing.now_epoch`), encoded
+    as u64 nanoseconds; cross-host clock skew makes the lag a lower
+    bound, so receivers clamp negatives to zero.  Reactor-layer only:
+    the consensus core and its WAL see the unwrapped inner message."""
+    msg: object                 # the wrapped consensus message
+    sent_ts: float = 0.0        # origin epoch seconds (0 = unstamped)
+    origin: str = ""            # origin node id ("" = use peer id)
+
+
 def _bits_encode(bits) -> bytes:
     out = u32(len(bits))
     by = bytearray((len(bits) + 7) // 8)
@@ -144,6 +160,9 @@ def encode_msg(msg) -> bytes:
                 _bits_encode(msg.proposal_pol))
     if isinstance(msg, ProposalHeartbeatMessage):
         return u8(TAG_PROPOSAL_HEARTBEAT) + msg.heartbeat.encode()
+    if isinstance(msg, StampedMessage):
+        return (u8(TAG_STAMPED) + u64(int(msg.sent_ts * 1e9)) +
+                lp_bytes(msg.origin.encode()) + encode_msg(msg.msg))
     raise TypeError(f"cannot encode {type(msg).__name__}")
 
 
@@ -182,4 +201,11 @@ def decode_msg(data: bytes):
     if tag == TAG_PROPOSAL_HEARTBEAT:
         from tendermint_tpu.types.proposal import Heartbeat
         return ProposalHeartbeatMessage(Heartbeat.decode(r))
+    if tag == TAG_STAMPED:
+        sent_ts = r.u64() / 1e9
+        origin = r.lp_bytes().decode()
+        inner = decode_msg(r.buf[r.pos:])
+        if isinstance(inner, StampedMessage):
+            raise ValueError("nested stamped envelope")
+        return StampedMessage(msg=inner, sent_ts=sent_ts, origin=origin)
     raise ValueError(f"unknown consensus message tag {tag:#x}")

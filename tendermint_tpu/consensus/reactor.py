@@ -24,7 +24,9 @@ from tendermint_tpu.consensus.state import (STEP_COMMIT,
 from tendermint_tpu.p2p.peer import Peer, Reactor
 from tendermint_tpu.p2p.types import ChannelDescriptor
 from tendermint_tpu.types import TYPE_PRECOMMIT, TYPE_PREVOTE
+from tendermint_tpu.utils import tracing
 from tendermint_tpu.utils.log import get_logger
+from tendermint_tpu.utils.metrics import REGISTRY
 
 log = get_logger("cons-rx")
 
@@ -353,6 +355,16 @@ class ConsensusReactor(Reactor):
             stop.set()
         self._notify_work()   # unblock its waiting gossip routines
 
+    def _stamp(self, msg) -> bytes:
+        """Encode a vote/proposal for the wire inside a send-time-stamped
+        envelope (timeline plane): the receiver's unwrap measures this
+        link's gossip fan-out lag.  State/bulk-data messages stay bare —
+        quorum formation is what the lag budget graded by live-rounds
+        cares about."""
+        return M.encode_msg(M.StampedMessage(
+            msg, sent_ts=tracing.now_epoch(),
+            origin=self.cs.node_id))
+
     # -- inbound demux (reference :159-302) ------------------------------
     def receive(self, ch_id: int, peer: Peer, raw: bytes) -> None:
         try:
@@ -360,6 +372,13 @@ class ConsensusReactor(Reactor):
         except (ValueError, IndexError) as e:
             self.switch.stop_peer_for_error(peer, f"bad consensus msg: {e}")
             return
+        if isinstance(msg, M.StampedMessage):
+            if msg.sent_ts > 0.0:
+                # cross-host clocks skew: a negative lag is a clock
+                # artifact, clamp rather than poison the histogram
+                REGISTRY.gossip_fanout_seconds.observe(
+                    max(0.0, tracing.now_epoch() - msg.sent_ts))
+            msg = msg.msg
         ps: PeerState = peer.get("consensus")
         if ps is None:
             return
@@ -561,7 +580,7 @@ class ConsensusReactor(Reactor):
         if rs.proposal is not None and rs.height == prs.height and \
                 rs.round == prs.round and not prs.proposal:
             if peer.send(DATA_CHANNEL,
-                         M.encode_msg(M.ProposalMessage(rs.proposal))):
+                         self._stamp(M.ProposalMessage(rs.proposal))):
                 ps.set_has_proposal(rs.proposal)
             if 0 <= rs.proposal.pol_round and rs.votes is not None:
                 pol = rs.votes.prevotes(rs.proposal.pol_round)
@@ -615,7 +634,7 @@ class ConsensusReactor(Reactor):
         vote = vs.get_by_index(idx)
         if vote is None:
             return False
-        if peer.send(VOTE_CHANNEL, M.encode_msg(M.VoteMessage(vote))):
+        if peer.send(VOTE_CHANNEL, self._stamp(M.VoteMessage(vote))):
             ps.set_has_vote(vote.height, vote.round, vote.type, idx,
                             vs.size())
             return True
@@ -667,7 +686,7 @@ class ConsensusReactor(Reactor):
                 if cands:
                     vote = random.choice(cands)
                     if peer.send(VOTE_CHANNEL,
-                                 M.encode_msg(M.VoteMessage(vote))):
+                                 self._stamp(M.VoteMessage(vote))):
                         ps.set_has_vote(vote.height, vote.round, vote.type,
                                         vote.validator_index, commit.size())
                         return True

@@ -20,6 +20,7 @@ import queue
 import struct
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass
 
 from tendermint_tpu import config as config_mod
@@ -53,6 +54,16 @@ STEP_PREVOTE_WAIT = 5
 STEP_PRECOMMIT = 6
 STEP_PRECOMMIT_WAIT = 7
 STEP_COMMIT = 8
+
+# height-lifecycle stages (telemetry plane): the four stages partition
+# each committed height's wall clock [first EnterNewRound, finalize] —
+# propose = waiting for the proposal, prevote = proposal -> prevote
+# quorum, precommit = prevote quorum -> precommit quorum, commit =
+# precommit quorum -> block applied.  Marks are clamped monotone at
+# finalize so the durations sum to the height wall EXACTLY (the same
+# sums-to-wall invariant utils/attribution.py holds for replay windows).
+STAGE_NAMES = ("propose", "prevote", "precommit", "commit")
+LIFECYCLE_CAP = 512     # per-node ring of completed height records
 
 STEP_NAMES = {
     STEP_NEW_HEIGHT: "NewHeight", STEP_NEW_ROUND: "NewRound",
@@ -88,7 +99,8 @@ class ConsensusState:
     def __init__(self, cfg: config_mod.ConsensusConfig, state: State,
                  proxy_consensus, block_store, mempool,
                  priv_validator=None, evsw: EventSwitch | None = None,
-                 wal_path: str = "", ticker=None, tx_indexer=None):
+                 wal_path: str = "", ticker=None, tx_indexer=None,
+                 node_id: str = ""):
         self.cfg = cfg
         self.proxy = proxy_consensus
         self.block_store = block_store
@@ -97,6 +109,13 @@ class ConsensusState:
         self.evsw = evsw or EventSwitch()
         self.tx_indexer = tx_indexer
         self.broadcast_cb = None          # reactor hook: fn(msg)
+        # --- timeline plane (telemetry/) ---
+        self.node_id = node_id            # identity stamped on lifecycle
+        self.commit_cb = None             # hook: fn(record) at commit site
+        self.lifecycle = deque(maxlen=LIFECYCLE_CAP)  # completed heights
+        self._stage_marks: dict[str, float] = {}      # perf ts per mark
+        self._height_t0: float | None = None  # first EnterNewRound (perf)
+        self._verify_wait_s = 0.0         # batchplane vote-verify wait
 
         self._queue: queue.Queue = queue.Queue(maxsize=10_000)
         self._ticker = ticker or TimeoutTicker(self._on_timeout_fire)
@@ -198,15 +217,30 @@ class ConsensusState:
     # ------------------------------------------------------------------
     # public inbound API (thread-safe; reference :425-470)
     # ------------------------------------------------------------------
-    def add_vote(self, vote: Vote, peer_id: str = "") -> None:
+    def add_vote(self, vote: Vote, peer_id: str = "",
+                 sent_ts: float = 0.0) -> None:
+        self._note_gossip_lag(sent_ts)
         self._queue.put((M.VoteMessage(vote), peer_id))
 
-    def set_proposal(self, proposal: Proposal, peer_id: str = "") -> None:
+    def set_proposal(self, proposal: Proposal, peer_id: str = "",
+                     sent_ts: float = 0.0) -> None:
+        self._note_gossip_lag(sent_ts)
         self._queue.put((M.ProposalMessage(proposal), peer_id))
 
     def add_proposal_block_part(self, height: int, round_: int, part,
-                                peer_id: str = "") -> None:
+                                peer_id: str = "",
+                                sent_ts: float = 0.0) -> None:
+        self._note_gossip_lag(sent_ts)
         self._queue.put((M.BlockPartMessage(height, round_, part), peer_id))
+
+    @staticmethod
+    def _note_gossip_lag(sent_ts: float) -> None:
+        """Fan-out lag from the origin's send stamp to ingest here.
+        Cross-process stamps ride different wall clocks, so a skewed
+        negative lag clamps to 0 rather than poisoning the histogram."""
+        if sent_ts > 0.0:
+            REGISTRY.gossip_fanout_seconds.observe(
+                max(0.0, tracing.now_epoch() - sent_ts))
 
     def set_peer_maj23(self, height, round_, type_, peer_id, block_id):
         with self._mtx:   # receive thread swaps self.votes on every height
@@ -465,6 +499,7 @@ class ConsensusState:
                 sel.append(v)
         if len(sel) < self.VOTE_MICROBATCH_MIN:
             return set()
+        t0v = time.perf_counter()
         try:
             with tracing.span("consensus.vote_microbatch",
                               cat=tracing.CAT_DEVICE,
@@ -477,6 +512,11 @@ class ConsensusState:
             log.warn("device fault in vote pre-verify; going scalar",
                      error=str(e)[:200])
             return set()
+        finally:
+            # batchplane verify wait attributable to this height's vote
+            # ingest — a timeline-plane competitor that steals from
+            # inside the quorum stages (reported, not partitioned)
+            self._verify_wait_s += time.perf_counter() - t0v
         REGISTRY.vote_microbatches.inc()
         REGISTRY.vote_microbatch_lanes.inc(len(sel))
         return {id(v) for v, good in zip(sel, ok) if good}
@@ -560,6 +600,12 @@ class ConsensusState:
         self.commit_round = -1
         self.last_commit = last_precommits
         self.state = state
+        # fresh lifecycle for the new height; t0 is set by the first
+        # EnterNewRound so the commit-timeout idle before round 0 never
+        # counts against the propose stage
+        self._stage_marks = {}
+        self._height_t0 = None
+        self._verify_wait_s = 0.0
 
     def _schedule_round_0(self) -> None:
         sleep = max(0.0, self.start_time - time.time())
@@ -631,6 +677,8 @@ class ConsensusState:
             # the histogram's p99 is where round churn becomes visible)
             REGISTRY.round_seconds_hist.observe(now - self._round_t0)
         self._round_t0 = now
+        if self._height_t0 is None:
+            self._height_t0 = time.perf_counter()
         tracing.instant("consensus.round", height=height, round=round_)
         self.round = round_
         self.step = STEP_NEW_ROUND
@@ -843,6 +891,7 @@ class ConsensusState:
             # no polka: precommit nil, keep any lock
             self._sign_add_vote(TYPE_PRECOMMIT, ZERO_BLOCK_ID)
             return
+        self._mark_stage("prevote_quorum")
         self.evsw.fire(ev.POLKA, self._round_step_event())
         if maj.is_zero():
             # +2/3 prevoted nil: unlock (reference :1112-1121)
@@ -901,6 +950,7 @@ class ConsensusState:
             return
         self.commit_round = commit_round
         self.commit_time = time.time()
+        self._mark_stage("precommit_quorum")
         self._new_step(STEP_COMMIT)
         maj = self.votes.precommits(commit_round).two_thirds_majority()
         assert maj is not None and not maj.is_zero()
@@ -967,12 +1017,75 @@ class ConsensusState:
         event_cache.fire(ev.NEW_BLOCK_HEADER, block.header)
         REGISTRY.blocks_committed.inc()
         REGISTRY.txs_committed.inc(len(block.txs))
+        self._finish_height(block)
         log.info("committed block", height=block.height,
                  hash=block.hash(), txs=len(block.txs),
                  app_hash=state_copy.app_hash)
         self._update_to_state(state_copy)
         event_cache.flush()
         self._schedule_round_0()
+
+    # ------------------------------------------------------------------
+    # height lifecycle (timeline plane; see STAGE_NAMES)
+    # ------------------------------------------------------------------
+    def _mark_stage(self, mark: str) -> None:
+        """First-occurrence stage mark for the current height.  Under
+        round churn the earliest mark wins; the monotone clamp at
+        finalize keeps the partition valid regardless."""
+        self._stage_marks.setdefault(mark, time.perf_counter())
+
+    def _finish_height(self, block) -> None:
+        """Close the height's lifecycle at the commit site: clamp the
+        stage marks into a monotone cut sequence partitioning
+        [height_t0, now], emit one categorized flight-recorder span per
+        stage plus a `consensus.height` envelope span, feed the stage
+        histograms, ring-buffer the record, and fire commit_cb — the
+        node-side commit timestamp the WireMesh sampler used to
+        quantize to its 50ms poll."""
+        if self._replay_mode:
+            return          # WAL replay timings are compressed nonsense
+        t_commit = time.perf_counter()
+        t0 = self._height_t0 if self._height_t0 is not None else t_commit
+        cuts = [min(t0, t_commit)]
+        for mark in ("proposal", "prevote_quorum", "precommit_quorum"):
+            t = self._stage_marks.get(mark, cuts[-1])
+            cuts.append(min(max(t, cuts[-1]), t_commit))
+        cuts.append(t_commit)
+        proposer = getattr(self.validators, "proposer", None)
+        addr = getattr(proposer, "address", b"")
+        rec = {
+            "node": self.node_id,
+            "height": block.height,
+            "round": self.commit_round,
+            "proposer": addr.hex() if isinstance(addr, bytes) else str(addr),
+            "t_start": tracing.perf_to_epoch(cuts[0]),
+            "t_proposal": tracing.perf_to_epoch(cuts[1]),
+            "t_prevote": tracing.perf_to_epoch(cuts[2]),
+            "t_precommit": tracing.perf_to_epoch(cuts[3]),
+            "t_commit": tracing.perf_to_epoch(cuts[4]),
+            "verify_wait_s": self._verify_wait_s,
+        }
+        lane = self.node_id or None
+        for name, lo, hi in zip(STAGE_NAMES, cuts, cuts[1:]):
+            tracing.RECORDER.record(
+                "consensus.stage." + name, tracing.perf_to_epoch(lo),
+                hi - lo, cat=tracing.CAT_CONSENSUS, lane=lane,
+                args={"height": block.height, "round": self.commit_round,
+                      "node": self.node_id, "stage": name})
+            REGISTRY.consensus_stage_seconds.labels(name).observe(hi - lo)
+        tracing.RECORDER.record(
+            "consensus.height", rec["t_start"], t_commit - cuts[0],
+            cat=tracing.CAT_CONSENSUS, lane=lane,
+            args={"height": block.height, "round": self.commit_round,
+                  "node": self.node_id, "proposer": rec["proposer"],
+                  "verify_wait_s": round(self._verify_wait_s, 6)})
+        REGISTRY.consensus_height_seconds.observe(t_commit - cuts[0])
+        self.lifecycle.append(rec)
+        if self.commit_cb is not None:
+            try:
+                self.commit_cb(rec)
+            except Exception as e:    # a telemetry hook must never
+                log.warn("commit_cb failed", error=str(e)[:200])  # wedge
 
     # ------------------------------------------------------------------
     # proposal / parts / votes ingestion (reference :1363-1565)
@@ -989,6 +1102,7 @@ class ConsensusState:
         if not ok:
             raise ValueError("invalid proposal signature")
         self.proposal = proposal
+        self._mark_stage("proposal")
         if (self.proposal_block_parts is None or
                 self.proposal_block_parts.header.hash !=
                 proposal.block_parts_header.hash):

@@ -107,7 +107,8 @@ class Node:
             config.consensus, initial_state, self.proxy_app.consensus,
             self.block_store, self.mempool,
             priv_validator=self.priv_validator, evsw=self.evsw,
-            wal_path=wal_path, tx_indexer=self.tx_indexer)
+            wal_path=wal_path, tx_indexer=self.tx_indexer,
+            node_id=config.base.moniker)
 
         # --- evidence pool (equivocation proofs, SURVEY §2.2) ---
         from tendermint_tpu.state.evidence import EvidencePool

@@ -94,6 +94,7 @@ class Routes:
                 "debug_trace_stop": self.debug_trace_stop,
                 "debug_flight_recorder": self.debug_flight_recorder,
                 "debug_doctor": self.debug_doctor,
+                "debug_timeline": self.debug_timeline,
                 "debug_bench_history": self.debug_bench_history,
             })
 
@@ -291,6 +292,24 @@ class Routes:
         if str(params.get("clear", "")).lower() in ("1", "true", "yes"):
             rec.clear()
         return out
+
+    def debug_timeline(self, params: dict) -> dict:
+        """This node's height-lifecycle dump for the mesh collector
+        (telemetry/collector.merge_dumps): the canonical per-height
+        records from the consensus core's ring, a wall-clock sample for
+        cross-node skew normalization, and the local stage histogram.
+        last=N keeps the N most recent heights."""
+        import time as _time
+        from tendermint_tpu.utils.metrics import REGISTRY
+        cs = self.node.consensus
+        records = list(getattr(cs, "lifecycle", ()))
+        last = int(params.get("last", 0) or 0)
+        if last > 0:
+            records = records[-last:]
+        return {"node": cs.node_id or self.node.config.base.moniker,
+                "wall_now": _time.time(),
+                "records": records,
+                "stage_seconds": REGISTRY.consensus_stage_seconds.snapshot()}
 
     def debug_doctor(self, params: dict) -> dict:
         """Pipeline attribution over the live flight recorder: per-window

@@ -478,10 +478,25 @@ def _dump_artifacts(ctx: ScenarioContext, result: ScenarioResult,
     d = os.path.join(root, f"{ctx.scenario.name}-seed{ctx.seed}")
     os.makedirs(d, exist_ok=True)
     ctx.recorder.dump(os.path.join(d, "trace.json"))
-    for fname, payload in (
-            ("metrics.json", ctx.metric_phases),
-            ("events.json", ctx.log.to_dict()),
-            ("result.json", result.to_dict())):
+    files = [("metrics.json", ctx.metric_phases),
+             ("events.json", ctx.log.to_dict()),
+             ("result.json", result.to_dict())]
+    # merged consensus timeline + doctor, rebuilt from the lifecycle
+    # spans in the recorder ring — so any failing/breaching rig run
+    # ships its per-node waterfall in the triage bundle.  Best-effort:
+    # a telemetry bug must never eat the primary artifacts.
+    try:
+        from tendermint_tpu import telemetry
+        records = telemetry.records_from_spans(ctx.recorder.snapshot())
+        if records:
+            timeline = telemetry.build_timeline(records)
+            files.append(("timeline.json",
+                          telemetry.to_chrome_trace(timeline)))
+            files.append(("consensus_doctor.json",
+                          telemetry.consensus_doctor(timeline)))
+    except Exception:
+        pass
+    for fname, payload in files:
         tmp = os.path.join(d, fname + ".tmp")
         with open(tmp, "w") as f:
             json.dump(_json_safe(payload), f, indent=1)

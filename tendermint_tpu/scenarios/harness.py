@@ -36,7 +36,9 @@ from tendermint_tpu.proxy import ClientCreator
 from tendermint_tpu.scenarios import fixtures
 from tendermint_tpu.state import execution
 from tendermint_tpu.state.state import get_state
+from tendermint_tpu.utils import tracing
 from tendermint_tpu.utils.db import MemDB
+from tendermint_tpu.utils.metrics import Histogram
 
 
 def wait_until(pred, timeout: float, poll: float = 0.02) -> bool:
@@ -59,7 +61,8 @@ class WireNode:
 
     def __init__(self, priv, gen, cfg: Config | None = None,
                  app: str = "kvstore", wal_path: str = "",
-                 state=None, conns=None, block_store=None):
+                 state=None, conns=None, block_store=None,
+                 node_id: str = ""):
         cfg = cfg or test_config()
         self.priv = priv
         st = state if state is not None else get_state(MemDB(), gen)
@@ -69,7 +72,8 @@ class WireNode:
                             else BlockStore(MemDB()))
         self.cs = ConsensusState(cfg.consensus, st, self.conns.consensus,
                                  self.block_store, self.mempool,
-                                 priv_validator=priv, wal_path=wal_path)
+                                 priv_validator=priv, wal_path=wal_path,
+                                 node_id=node_id)
 
 
 def wire_net(chain_id: str, n: int, app: str = "kvstore",
@@ -160,6 +164,19 @@ class WireMesh:
         self._samples: list[tuple[int, float]] = []   # (height, t_seen)
         self._sampler: threading.Thread | None = None
         self._sampler_stop = threading.Event()
+        # -- timeline plane (telemetry/) --
+        # per-node height lifecycle records delivered by the commit_cb
+        # hook at the COMMIT SITE — the exact-timestamp source the 50ms
+        # poll sampler above only approximates
+        self.lifecycle_records: list[dict] = []
+        self._commit_stamps: dict[int, float] = {}  # height -> first commit
+        # per-run gossip fan-out lag (send stamp -> delivery), kept
+        # mesh-local so sequential scenario runs in one process don't
+        # read each other through the global REGISTRY
+        self.gossip_hist = Histogram(Histogram.LATENCY_BOUNDS)
+        # (i, j) -> [count, sum_s, max_s]; each key is written only by
+        # sender i's consensus thread, so per-op GIL atomicity suffices
+        self._link_stats: dict[tuple[int, int], list] = {}
 
     # -- construction / restart ----------------------------------------
 
@@ -181,10 +198,24 @@ class WireMesh:
                                   check_last_commit=False)
             replayed += 1
         self._last_replay = (replayed, time.time() - t0)
-        return WireNode(self.privs[i], self.gen,
+        node = WireNode(self.privs[i], self.gen,
                         cfg=config_with_timeouts(self._timeouts),
                         app=self.app, state=st, conns=conns,
-                        block_store=store)
+                        block_store=store, node_id=f"n{i}")
+        node.cs.commit_cb = self._on_lifecycle   # survives restarts
+        return node
+
+    def _on_lifecycle(self, rec: dict) -> None:
+        """commit_cb from every node: ring the record into the mesh's
+        merged timeline and stamp the height's FIRST commit — the
+        commit-site timestamps commit_latencies() prefers over the poll
+        sampler."""
+        with self._lock:
+            self.lifecycle_records.append(rec)
+            h, t = rec["height"], rec["t_commit"]
+            cur = self._commit_stamps.get(h)
+            if cur is None or t < cur:
+                self._commit_stamps[h] = t
 
     def _make_cb(self, me_i: int):
         def cb(msg):
@@ -194,18 +225,36 @@ class WireMesh:
                 down = set(self._down)
                 cut = set(self._cut)
                 nodes = list(self.nodes)
+            # origin send stamp: one per broadcast, so every link's lag
+            # includes the sender-loop serialization ahead of it — the
+            # fan-out cost the gossip_fanout_p99 budget grades
+            t0 = tracing.now_epoch()
+            stats = self._link_stats
             for j, other in enumerate(nodes):
                 if j == me_i or j in down:
                     continue
                 if frozenset((me_i, j)) in cut:
                     continue
                 if isinstance(msg, M.VoteMessage):
-                    other.cs.add_vote(msg.vote, peer_id="net")
+                    other.cs.add_vote(msg.vote, peer_id="net", sent_ts=t0)
                 elif isinstance(msg, M.ProposalMessage):
-                    other.cs.set_proposal(msg.proposal, peer_id="net")
+                    other.cs.set_proposal(msg.proposal, peer_id="net",
+                                          sent_ts=t0)
                 elif isinstance(msg, M.BlockPartMessage):
                     other.cs.add_proposal_block_part(
-                        msg.height, msg.round, msg.part, peer_id="net")
+                        msg.height, msg.round, msg.part, peer_id="net",
+                        sent_ts=t0)
+                else:
+                    continue
+                lag = tracing.now_epoch() - t0
+                self.gossip_hist.observe(lag)
+                st = stats.get((me_i, j))
+                if st is None:
+                    st = stats[(me_i, j)] = [0, 0.0, 0.0]
+                st[0] += 1
+                st[1] += lag
+                if lag > st[2]:
+                    st[2] = lag
         return cb
 
     # -- lifecycle ------------------------------------------------------
@@ -294,8 +343,17 @@ class WireMesh:
             self._sampler = None
 
     def commit_latencies(self) -> list[float]:
-        """Gaps between consecutive sampled commits (seconds)."""
-        ts = [t for _h, t in self._samples]
+        """Gaps between consecutive commits (seconds), from the
+        commit-site stamps the nodes' commit_cb hooks deliver — exact,
+        not quantized to the sampler's 50ms poll.  Falls back to the
+        poll samples when no hook fired (e.g. a rig built before
+        start(), or every node crashed pre-commit)."""
+        with self._lock:
+            stamps = dict(self._commit_stamps)
+        if stamps:
+            ts = [stamps[h] for h in sorted(stamps)]
+        else:
+            ts = [t for _h, t in self._samples]
         return [b - a for a, b in zip(ts, ts[1:])]
 
     def commit_latency_p99(self) -> float | None:
@@ -303,6 +361,36 @@ class WireMesh:
         if not gaps:
             return None
         return gaps[min(len(gaps) - 1, int(0.99 * len(gaps)))]
+
+    def timeline_records(self) -> list[dict]:
+        """Per-node height lifecycle records (see ConsensusState
+        STAGE_NAMES) accumulated by the commit hooks — the mesh
+        collector's in-process input."""
+        with self._lock:
+            return list(self.lifecycle_records)
+
+    def gossip_stats(self) -> dict:
+        """Mesh-wide gossip fan-out aggregates.  `per_receiver_wait_s`
+        divides the total per-delivery lag by the fan-out degree — the
+        serialized gossip wait ONE receiver experienced over the run,
+        commensurate with per-node wall clock (the doctor's gossip_delay
+        thief).  `worst_link` is the (sender, receiver) pair with the
+        largest single-delivery lag."""
+        with self._lock:
+            links = {k: list(v) for k, v in self._link_stats.items()}
+        count = sum(v[0] for v in links.values())
+        total = sum(v[1] for v in links.values())
+        worst = max(links.items(), key=lambda kv: kv[1][2], default=None)
+        return {
+            "count": count,
+            "total_s": total,
+            "mean_s": total / count if count else 0.0,
+            "p50": self.gossip_hist.quantile(0.50),
+            "p99": self.gossip_hist.quantile(0.99),
+            "max_s": worst[1][2] if worst else 0.0,
+            "worst_link": list(worst[0]) if worst else None,
+            "per_receiver_wait_s": total / max(self.n - 1, 1),
+        }
 
 
 # -- fast-sync rig ----------------------------------------------------------

@@ -19,10 +19,12 @@ Two properties of the wire rig shape every invariant here:
   remaining current voters clear the quorum threshold with margin).
 
 Alongside the wall-clock budget, each rig declares METRIC budgets —
-commit latency p99 (from the mesh's commit sampler), rounds-per-height
-(round churn from stale proposers and partition waves), and ladder
-demotion count — checked by the engine as first-class invariants and
-ledgered per-seed.
+commit latency p99 (timestamped at the commit site by the lifecycle
+hook, with the 50ms poll sampler as fallback), rounds-per-height
+(round churn from stale proposers and partition waves), ladder
+demotion count, and stage-level timeline budgets (prevote-quorum p99
+and gossip fan-out p99 from the merged telemetry timeline) — checked
+by the engine as first-class invariants and ledgered per-seed.
 """
 
 from __future__ import annotations
@@ -185,6 +187,31 @@ def _live_rounds_body(ctx, *, n: int, net_seed: int, target_heights: int,
     # budget check reports it missing instead of grading a placeholder
     if p99 is not None:
         budget_metrics["commit_latency_p99"] = round(p99, 3)
+    # stage-level budgets from the mesh's merged timeline (telemetry/):
+    # p99 duration of each quorum stage across every (node, height) and
+    # the gossip fan-out p99 across every delivery.  Same omit-if-empty
+    # rule as commit_latency_p99 — the engine grades MISSING as a
+    # breach, so a rig that never committed reads red, not green.
+    from tendermint_tpu import telemetry
+    timeline = telemetry.collect_mesh(mesh)
+    telemetry.feed_registry(timeline)
+    stats = timeline["stage_stats"]
+    if stats.get("prevote", {}).get("count"):
+        budget_metrics["prevote_quorum_p99"] = round(
+            stats["prevote"]["p99"], 3)
+        budget_metrics["precommit_quorum_p99"] = round(
+            stats["precommit"]["p99"], 3)
+    gossip = timeline["gossip"]
+    if gossip.get("count"):
+        budget_metrics["gossip_fanout_p99"] = round(gossip["p99"], 4)
+    doctor = telemetry.consensus_doctor(timeline)
+    ctx.note("live.timeline", heights=len(timeline["heights"]),
+             nodes=len(timeline["nodes"]),
+             largest_thief=doctor["largest_thief"],
+             sums_to_wall=doctor["sums_to_wall"],
+             commit_spread_p99=round(telemetry.collector.percentile(
+                 [h["commit_spread_s"] for h in timeline["heights"]],
+                 0.99), 4))
     ctx.note("live.result", quorum_height=quorum_h,
              target=target_heights, rounds_delta=rounds_delta,
              total_height_gain=total_height_gain,
@@ -239,7 +266,13 @@ register(
     smoke=False, budget_s=420.0, backend="rig",
     budgets={"commit_latency_p99": {"max": 30.0},
              "rounds_per_height": {"max": 3.0},
-             "ladder_demotions": {"max": 50}})(
+             "ladder_demotions": {"max": 50},
+             # stage-level budgets (telemetry/): a prevote stage is
+             # bounded by the same round-churn ceiling as commit
+             # latency; gossip fan-out is in-process queue handoff, so
+             # seconds of lag means the sender loop starved under GIL
+             "prevote_quorum_p99": {"max": 30.0},
+             "gossip_fanout_p99": {"max": 5.0}})(
     lambda ctx: _live_rounds_body(
         ctx, n=50, net_seed=5, target_heights=10,
         timeouts=LIVE_TIMEOUTS_50, partition_count=8, crash_count=1,
@@ -260,7 +293,9 @@ register(
     smoke=False, budget_s=600.0, backend="rig",
     budgets={"commit_latency_p99": {"max": 60.0},
              "rounds_per_height": {"max": 4.0},
-             "ladder_demotions": {"max": 50}})(
+             "ladder_demotions": {"max": 50},
+             "prevote_quorum_p99": {"max": 60.0},
+             "gossip_fanout_p99": {"max": 10.0}})(
     lambda ctx: _live_rounds_body(
         ctx, n=100, net_seed=5, target_heights=6,
         timeouts=LIVE_TIMEOUTS_100, partition_count=15, crash_count=2,

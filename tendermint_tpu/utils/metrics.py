@@ -369,6 +369,20 @@ class Registry:
         self.mempool_rejected = CounterVec("reason")
         self.mempool_evicted = CounterVec("reason")
         self.mempool_admit_seconds = Histogram(Histogram.LATENCY_BOUNDS)
+        # consensus timeline plane (telemetry/): per-stage height
+        # lifecycle durations (propose / prevote / precommit / commit —
+        # the four stages partition each height's wall clock, same
+        # sums-to-wall invariant as utils/attribution.py), gossip
+        # fan-out lag (origin send-stamp -> ingest at the receiver),
+        # batchplane verify wait attributable to vote ingest, and a
+        # per-node last-committed-height gauge fed by the mesh
+        # collector (node ids are hostname-shaped: dashes/dots).
+        self.consensus_stage_seconds = HistogramVec(
+            "stage", Histogram.DURATION_BOUNDS)
+        self.consensus_height_seconds = Histogram(
+            Histogram.DURATION_BOUNDS)
+        self.gossip_fanout_seconds = Histogram(Histogram.LATENCY_BOUNDS)
+        self.timeline_node_height = GaugeVec("node")
 
     def snapshot(self) -> dict:
         up = max(time.time() - self._start, 1e-9)
@@ -445,6 +459,13 @@ class Registry:
             "mempool_evicted": dict(self.mempool_evicted.items()),
             "mempool_admit_seconds":
                 self.mempool_admit_seconds.snapshot(),
+            "consensus_stage_seconds":
+                self.consensus_stage_seconds.snapshot(),
+            "consensus_height_seconds":
+                self.consensus_height_seconds.snapshot(),
+            "gossip_fanout_seconds":
+                self.gossip_fanout_seconds.snapshot(),
+            "timeline_node_height": dict(self.timeline_node_height.items()),
         }
 
 

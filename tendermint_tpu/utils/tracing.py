@@ -63,6 +63,12 @@ CAT_APPLY = "apply"        # host-side ABCI/store application
 CAT_COMPILE = "compile"    # XLA compile / first-call executables
 CAT_TRANSFER = "transfer"  # host<->device copies
 CAT_SCALAR = "scalar"      # scalar/python fallback crypto
+# Timeline-plane categories (telemetry/): consensus height-lifecycle
+# stages and mesh-collector work.  These never appear in PARTITION so
+# they cannot pollute the replay attribution; they exist so lifecycle
+# spans are categorized (tmlint span-category) and filterable in traces.
+CAT_CONSENSUS = "consensus"  # height lifecycle stages (propose..commit)
+CAT_TELEMETRY = "telemetry"  # mesh collector / timeline merge work
 # Deliberately-uncategorized: host bookkeeping spans (WAL writes,
 # supervised-ladder wrappers whose inner spans carry the categories).
 # Passing cat=CAT_NONE skips prefix inference AND keeps the span out of
@@ -86,7 +92,20 @@ _CAT_BY_PREFIX = (
     ("fastsync.prepare", CAT_PREP),
     ("fastsync.lookahead", CAT_PREP),
     ("fastsync.apply", CAT_APPLY),
+    # timeline plane: lifecycle stages + collector.  consensus spans that
+    # ARE device/apply work (vote_microbatch, apply) pass cat= explicitly
+    # at the call site, which always wins over this prefix.
+    ("consensus.", CAT_CONSENSUS),
+    ("telemetry.", CAT_TELEMETRY),
 )
+
+
+def now_epoch() -> float:
+    """Current time on the recorder's wall-clock axis (monotonic clock
+    anchored to the epoch once at import).  Use this — not time.time() —
+    to stamp p2p envelopes: an NTP step mid-run cannot make two stamps
+    from the same process go backwards."""
+    return _EPOCH_T0 + time.perf_counter()
 
 
 def default_category(name: str) -> str | None:

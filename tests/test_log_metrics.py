@@ -220,6 +220,31 @@ def test_prometheus_text_escapes_label_values():
             assert " " in ln and "\n" not in ln
 
 
+def test_prometheus_text_escapes_timeline_labels():
+    """The timeline plane adds node- and stage-labelled series whose
+    label values are operator-supplied monikers — hostname-shaped
+    (dashes, dots) at best, quote/backslash/newline at worst.  Dashes
+    and dots need NO escaping; the hostile trio must round-trip
+    escaped, one physical line per series."""
+    r = metrics.Registry()
+    r.timeline_node_height.labels("val-3.eu-west.example.com").set(42)
+    r.timeline_node_height.labels('n"0\\weird\nhost').set(7)
+    r.consensus_stage_seconds.labels("prevote").observe(0.2)
+    text = metrics.prometheus_text(r)
+    lines = text.splitlines()
+    assert ('tendermint_timeline_node_height'
+            '{node="val-3.eu-west.example.com"} 42' in lines)
+    assert ('tendermint_timeline_node_height'
+            '{node="n\\"0\\\\weird\\nhost"} 7' in lines)
+    assert ('tendermint_consensus_stage_seconds_count{stage="prevote"} 1'
+            in lines)
+    assert any(ln.startswith('tendermint_consensus_stage_seconds_bucket'
+                             '{stage="prevote",le="') for ln in lines)
+    for ln in lines:
+        if ln and not ln.startswith("#"):
+            assert " " in ln and "\n" not in ln
+
+
 def test_prometheus_text_process_start_and_build_info():
     metrics.set_build_info(test_label="x1")
     text = metrics.prometheus_text(metrics.Registry())

@@ -1,0 +1,353 @@
+"""Consensus timeline plane: mesh collector, doctor, and surfaces.
+
+Pinned here:
+
+- merge_dumps degrades PER NODE under adversarial input — clock-skewed
+  nodes are normalized onto one axis, truncated/empty/garbage dumps are
+  dropped by name, duplicate (node, height) rows keep the earliest
+  commit — and never corrupts the healthy nodes' waterfall
+- build_timeline's sums-to-wall invariant: each height row's stage
+  partition sums to its wall clock exactly (attribution discipline),
+  and the doctor carries the residual so a consumer can check it
+- the Chrome trace surface: one track (tid + thread_name metadata
+  event) per node, schema stamped in otherData, and the offline
+  round-trip records_from_spans(spans_from_chrome(trace))
+- the tier-1 smoke: a small live WireMesh rig -> merged timeline ->
+  doctor report, with the registry fed and commit-site stamps present
+- the commit-latency quantization regression: latencies come from the
+  commit-site hook stamps, not the 50ms sampler poll (which snapped
+  every gap to a poll multiple); the poll stays as fallback
+"""
+
+import json
+
+import pytest
+
+from tendermint_tpu import telemetry
+from tendermint_tpu.crypto import backend as cb
+from tendermint_tpu.scenarios import harness
+from tendermint_tpu.telemetry import (
+    CONSENSUS_DOCTOR_SCHEMA,
+    STAGES,
+    TIMELINE_SCHEMA,
+    build_timeline,
+    consensus_doctor,
+    merge_dumps,
+    normalize_record,
+    records_from_spans,
+    render_consensus_report,
+    to_chrome_trace,
+)
+from tendermint_tpu.utils import attribution, tracing
+from tendermint_tpu.utils.metrics import REGISTRY
+
+pytestmark = pytest.mark.faults
+
+CHAIN = "timeline-chain"
+
+
+def _rec(node, height, t0, stage=0.1, verify=0.0, rnd=0, commit=None):
+    """A well-formed lifecycle record with equal stage widths (or an
+    explicit commit cut)."""
+    t_commit = t0 + 4 * stage if commit is None else commit
+    return {"node": node, "height": height, "round": rnd,
+            "proposer": "ab12", "t_start": t0,
+            "t_proposal": t0 + stage, "t_prevote": t0 + 2 * stage,
+            "t_precommit": t0 + 3 * stage, "t_commit": t_commit,
+            "verify_wait_s": verify}
+
+
+# -- normalize_record --------------------------------------------------------
+
+
+def test_normalize_record_rejects_malformed():
+    assert normalize_record(None) is None
+    assert normalize_record("nope") is None
+    assert normalize_record({"height": 3}) is None          # no timestamps
+    assert normalize_record(_rec("n0", 0, 10.0)) is None    # height < 1
+    bad = _rec("n0", 2, 10.0)
+    bad["t_prevote"] = "soon"
+    assert normalize_record(bad) is None
+
+
+def test_normalize_record_clamps_cuts_monotone():
+    raw = _rec("n0", 5, 100.0)
+    raw["t_prevote"] = 99.0       # behind t_proposal
+    raw["t_precommit"] = 999.0    # beyond t_commit
+    rec = normalize_record(raw)
+    cuts = [rec[k] for k in ("t_start", "t_proposal", "t_prevote",
+                             "t_precommit", "t_commit")]
+    assert cuts == sorted(cuts)
+    assert cuts[-1] == raw["t_commit"]
+    # the clamped record still satisfies sums-to-wall
+    durs = telemetry.collector.stage_durations(rec)
+    assert sum(durs.values()) == pytest.approx(
+        rec["t_commit"] - rec["t_start"], abs=1e-9)
+
+
+# -- merge_dumps under adversarial input -------------------------------------
+
+
+def test_merge_dumps_normalizes_clock_skew():
+    """Two nodes observed the same real commits, but node b's wall
+    clock runs 5s fast: after the merge both land on one axis."""
+    a = {"node": "a", "wall_now": 1000.0,
+         "records": [_rec("a", h, 990.0 + h) for h in (1, 2, 3)]}
+    b = {"node": "b", "wall_now": 1005.0,
+         "records": [_rec("b", h, 995.0 + h) for h in (1, 2, 3)]}
+    merged = merge_dumps([a, b], ref_wall=1000.0)
+    assert merged["offsets"] == {"a": 0.0, "b": 5.0}
+    by_h = {}
+    for r in merged["records"]:
+        by_h.setdefault(r["height"], []).append(r["t_commit"])
+    for h, commits in by_h.items():
+        assert max(commits) - min(commits) == pytest.approx(0.0, abs=1e-9)
+
+
+def test_merge_dumps_degrades_per_node_never_corrupts():
+    good = {"node": "good", "wall_now": 50.0,
+            "records": [_rec("good", 1, 40.0), _rec("good", 2, 41.0)]}
+    truncated = {"node": "trunc", "wall_now": 50.0, "records": []}
+    garbage = {"node": "garb", "wall_now": 50.0,
+               "records": [{"nonsense": True}, 7, None]}
+    missing = {"node": "lost", "wall_now": 50.0, "records": None}
+    merged = merge_dumps([good, truncated, garbage, missing, "not-a-dump"],
+                         ref_wall=50.0)
+    assert {r["node"] for r in merged["records"]} == {"good"}
+    assert len(merged["records"]) == 2
+    assert merged["dropped"] == {
+        "trunc": "empty or truncated record list",
+        "garb": "no valid records",
+        "lost": "empty or truncated record list",
+        "dump4": "not a dict",
+    }
+    # a node with an unusable wall_now still merges, just unshifted
+    noclock = {"node": "noclock", "records": [_rec("noclock", 1, 40.5)]}
+    merged = merge_dumps([good, noclock], ref_wall=50.0)
+    assert merged["offsets"]["noclock"] == 0.0
+    assert {r["node"] for r in merged["records"]} == {"good", "noclock"}
+
+
+def test_merge_dumps_duplicate_height_keeps_earliest_commit():
+    dup = {"node": "d", "wall_now": 0.0,
+           "records": [_rec("d", 1, 10.0, commit=11.0),
+                       _rec("d", 1, 10.0, commit=10.4),
+                       _rec("d", 1, 10.0, commit=12.0)]}
+    merged = merge_dumps([dup], ref_wall=0.0)
+    assert len(merged["records"]) == 1
+    assert merged["records"][0]["t_commit"] == pytest.approx(10.4)
+
+
+# -- build_timeline / sums-to-wall -------------------------------------------
+
+
+def _two_node_records():
+    recs = []
+    for h in (1, 2, 3):
+        t0 = 100.0 + h
+        # fast committes first; slow lags 0.2s and stalls in prevote
+        recs.append(normalize_record(_rec("fast", h, t0, stage=0.05)))
+        slow = _rec("slow", h, t0, stage=0.05, commit=t0 + 0.4)
+        slow["t_prevote"] = t0 + 0.3
+        slow["t_precommit"] = t0 + 0.35
+        recs.append(normalize_record(slow))
+    return recs
+
+
+def test_build_timeline_sums_to_wall_and_spread():
+    tl = build_timeline(_two_node_records())
+    assert tl["schema"] == TIMELINE_SCHEMA
+    assert tl["nodes"] == ["fast", "slow"]
+    assert tl["height_range"] == [1, 3]
+    for row in tl["heights"]:
+        # representative row = first committer; partition sums to wall
+        assert row["first_commit_node"] == "fast"
+        assert sum(row["stages"].values()) == pytest.approx(
+            row["wall_s"], abs=1e-9)
+        assert row["commit_spread_s"] == pytest.approx(0.2, abs=1e-9)
+        assert row["last_commit_node"] == "slow"
+        # and so does every per-node cell
+        for cell in row["nodes"].values():
+            assert sum(cell["stages"].values()) == pytest.approx(
+                cell["wall_s"], abs=1e-9)
+    assert set(tl["stage_stats"]) == set(STAGES)
+    assert tl["stage_stats"]["prevote"]["count"] == 6
+
+
+def test_consensus_doctor_names_thief_and_straggler():
+    tl = build_timeline(_two_node_records())
+    rep = consensus_doctor(tl, range_len=2)
+    assert rep["schema"] == CONSENSUS_DOCTOR_SCHEMA
+    assert rep["sums_to_wall"] is True
+    assert rep["partition_residual_s"] <= 1e-6
+    assert rep["height_count"] == 3
+    # ranges chunk contiguous heights: [1,2] and [3,3]
+    assert [r["heights"] for r in rep["ranges"]] == [[1, 2], [3, 3]]
+    for r in rep["ranges"]:
+        assert set(r["stages"]) == set(STAGES)
+        assert r["largest_thief"] in r["thieves"]
+        # the slow node trails every commit -> it is the straggler
+        assert r["straggler_node"] == "slow"
+        # thief components from the partition sum to range wall
+        partition = (r["thieves"]["slow_proposer"]
+                     + r["thieves"]["quorum_straggler"]
+                     + r["thieves"]["commit_apply"])
+        assert partition == pytest.approx(r["wall_s"], abs=1e-6)
+    text = render_consensus_report(rep)
+    assert "sums-to-wall holds" in text
+    assert "largest thief" in text
+
+
+def test_consensus_doctor_competitors_do_not_break_partition():
+    """verify-wait and gossip delay are COMPETITORS: they may win
+    largest_thief without ever adding to the stage partition sum."""
+    recs = [normalize_record(_rec("n0", h, 10.0 + h, stage=0.01,
+                                  verify=5.0))
+            for h in (1, 2)]
+    gossip = {"count": 10, "total_s": 0.5, "per_receiver_wait_s": 0.1,
+              "p50": 0.01, "p99": 0.05, "max_s": 0.06,
+              "worst_link": [0, 1], "mean_s": 0.05}
+    rep = consensus_doctor(build_timeline(recs, gossip=gossip))
+    assert rep["largest_thief"] == "batchplane_queue_wait"
+    assert rep["sums_to_wall"] is True
+    assert rep["gossip"]["count"] == 10
+    assert rep["thieves"]["gossip_delay"] == pytest.approx(0.1, abs=1e-9)
+
+
+# -- Chrome trace surface ----------------------------------------------------
+
+
+def test_chrome_trace_one_track_per_node_and_round_trip():
+    tl = build_timeline(_two_node_records())
+    trace = to_chrome_trace(tl)
+    # stays JSON-serializable end to end (the CLI writes it verbatim)
+    trace = json.loads(json.dumps(trace))
+    assert trace["otherData"]["schema"] == TIMELINE_SCHEMA
+    assert trace["otherData"]["nodes"] == ["fast", "slow"]
+    names = {ev["args"]["name"] for ev in trace["traceEvents"]
+             if ev.get("ph") == "M" and ev["name"] == "thread_name"}
+    assert names == {"fast", "slow"}          # one track per node
+    tids = {ev["tid"] for ev in trace["traceEvents"] if ev.get("ph") == "X"}
+    assert len(tids) == 2
+    stage_events = [ev for ev in trace["traceEvents"]
+                    if ev["name"].startswith("consensus.stage.")]
+    assert len(stage_events) == 3 * 2 * len(STAGES)
+    # offline path: records rebuilt from the dumped trace agree
+    back = records_from_spans(attribution.spans_from_chrome(trace))
+    assert len(back) == 6
+    orig = {(r["node"], r["height"]): r for r in _two_node_records()}
+    for r in back:
+        o = orig[(r["node"], r["height"])]
+        assert r["t_commit"] == pytest.approx(o["t_commit"], abs=1e-5)
+        assert r["t_start"] == pytest.approx(o["t_start"], abs=1e-5)
+
+
+def test_records_from_spans_skips_truncated_heights():
+    """A ring that wrapped mid-height leaves a partial stage set; the
+    rebuild drops that (node, height) instead of faking cuts."""
+    tl = build_timeline([normalize_record(_rec("n0", 1, 5.0))])
+    spans = attribution.spans_from_chrome(to_chrome_trace(tl))
+    partial = [s for s in spans if s["name"] != "consensus.stage.commit"]
+    assert records_from_spans(partial) == []
+    assert len(records_from_spans(spans)) == 1
+
+
+# -- live rig smoke ----------------------------------------------------------
+
+
+@pytest.fixture()
+def scalar_backend():
+    """Pin the python crypto backend: a lazily-built device backend
+    would pay its table build under the backend lock inside a consensus
+    thread, wedging every node in the rig."""
+    prev = cb._current
+    cb._current = cb.PythonBackend()
+    try:
+        yield
+    finally:
+        cb._current = prev
+
+
+def test_wiremesh_timeline_smoke(scalar_backend):
+    """A 4-validator rig commits a few heights; the collector merges the
+    commit hooks' records into a waterfall with one Chrome-trace track
+    per node, the doctor report carries its machine-readable fields, and
+    the timeline feeds the /metrics registry."""
+    mesh = harness.WireMesh(CHAIN, 4, seed=3)
+    mesh.start()
+    try:
+        assert harness.wait_until(lambda: mesh.quorum_height() >= 3,
+                                  timeout=60)
+    finally:
+        mesh.stop()
+
+    # commit-site stamps drove the latency path (not the poll sampler)
+    assert mesh._commit_stamps
+    assert all(g >= 0 for g in mesh.commit_latencies())
+
+    tl = telemetry.collect_mesh(mesh)
+    assert tl["schema"] == TIMELINE_SCHEMA
+    assert len(tl["heights"]) >= 3
+    assert len(tl["nodes"]) >= 3          # quorum at minimum
+    for row in tl["heights"]:
+        assert sum(row["stages"].values()) == pytest.approx(
+            row["wall_s"], abs=1e-6)
+    assert tl["gossip"]["count"] > 0
+    assert tl["gossip"]["per_receiver_wait_s"] >= 0.0
+
+    trace = to_chrome_trace(tl)
+    tracks = [ev for ev in trace["traceEvents"]
+              if ev.get("ph") == "M" and ev["name"] == "thread_name"]
+    assert len(tracks) == len(tl["nodes"])
+    assert trace["otherData"]["schema"] == TIMELINE_SCHEMA
+
+    rep = consensus_doctor(tl)
+    for key in ("schema", "ranges", "thieves", "largest_thief",
+                "partition_residual_s", "sums_to_wall", "stage_stats"):
+        assert key in rep
+    assert rep["schema"] == CONSENSUS_DOCTOR_SCHEMA
+    assert rep["sums_to_wall"] is True
+    assert rep["largest_thief"] in rep["thieves"]
+
+    before = REGISTRY.consensus_stage_seconds.labels("prevote").count
+    telemetry.feed_registry(tl)
+    assert REGISTRY.consensus_stage_seconds.labels("prevote").count > before
+    node = tl["nodes"][0]
+    assert REGISTRY.timeline_node_height.labels(node).value >= 3
+
+    # and the rig's own consensus threads emitted categorized spans
+    spans = [s for s in tracing.RECORDER.snapshot()
+             if s["name"].startswith("consensus.stage.")]
+    assert spans and all(s["cat"] == tracing.CAT_CONSENSUS for s in spans)
+
+
+# -- commit-latency quantization regression ----------------------------------
+
+
+def test_commit_latencies_not_quantized_to_poll(monkeypatch):
+    """The old sampler stamped commits on a 50ms poll, snapping every
+    p99 to a poll multiple.  Commit-site stamps carry the true gaps;
+    the poll samples remain only as fallback."""
+    import threading
+    from types import SimpleNamespace
+
+    gaps = [0.013, 0.027, 0.041]      # deliberately off the 50ms grid
+    t, stamps = 100.0, {}
+    for h, g in enumerate([0.0] + gaps, start=1):
+        t += g
+        stamps[h] = t
+    poll = [(h, 100.0 + 0.05 * h) for h in stamps]   # quantized fallback
+    mesh = SimpleNamespace(_lock=threading.Lock(),
+                           _commit_stamps=stamps, _samples=poll)
+    mesh.commit_latencies = lambda: harness.WireMesh.commit_latencies(mesh)
+
+    got = harness.WireMesh.commit_latencies(mesh)
+    assert got == pytest.approx(gaps, abs=1e-9)
+    assert all(abs(g / 0.05 - round(g / 0.05)) > 1e-6 for g in got)
+    p99 = harness.WireMesh.commit_latency_p99(mesh)
+    assert abs(p99 / 0.05 - round(p99 / 0.05)) > 1e-6
+
+    # fallback: no commit hook ever fired -> the poll samples answer
+    mesh._commit_stamps = {}
+    fallback = harness.WireMesh.commit_latencies(mesh)
+    assert fallback == pytest.approx([0.05] * 3, abs=1e-9)

@@ -310,6 +310,33 @@ def test_span_category_unknown_prefix_flagged(tmp_path):
     assert "mystery.phase" in spans[0].message
 
 
+def test_span_category_covers_timeline_prefixes(tmp_path):
+    """Golden fixtures for the consensus timeline plane: consensus.*
+    and telemetry.* names resolve through the prefix table, so the
+    lifecycle / collector spans need no cat= keyword — while a typo'd
+    prefix right next to them is still flagged."""
+    findings = lint_src(tmp_path, """
+        from tendermint_tpu.utils import tracing
+
+        def lifecycle():
+            with tracing.span("consensus.stage.propose"):
+                pass
+            with tracing.span("consensus.height"):
+                pass
+
+        def collector():
+            with tracing.span("telemetry.merge"):
+                pass
+
+        def typo():
+            with tracing.span("consenus.stage.propose"):
+                pass
+        """)
+    spans = [f for f in findings if f.rule == "span-category"]
+    assert len(spans) == 1
+    assert "consenus.stage.propose" in spans[0].message
+
+
 def test_metric_name_series_collision_and_bad_label(tmp_path):
     findings = lint_src(tmp_path, """
         class Registry:
